@@ -1,0 +1,22 @@
+from blades_tpu.ops.aggregators import (  # noqa: F401
+    AGGREGATORS,
+    Aggregator,
+    Centeredclipping,
+    Clippedclustering,
+    DnC,
+    FLTrust,
+    GeoMed,
+    Mean,
+    Median,
+    Multikrum,
+    Signguard,
+    Trimmedmean,
+    get_aggregator,
+)
+from blades_tpu.ops.masked import (  # noqa: F401
+    clip_rows_to_norm,
+    clip_to_norm,
+    masked_mean,
+    masked_median,
+    median,
+)

@@ -1,0 +1,127 @@
+"""Jittable clustering primitives.
+
+The reference leans on sklearn inside two aggregators — ``KMeans(2)`` over
+sign-statistics features (ref: fllib/aggregators/signguard.py:59-66) and
+2-cluster ``AgglomerativeClustering`` over a precomputed cosine-distance
+matrix (ref: fllib/aggregators/clippedclustering.py:52-60).  sklearn is a
+host-side, dynamically-shaped dependency, so here both are re-implemented as
+fixed-shape XLA programs: Lloyd iterations with farthest-point init for
+k-means, and a Lance-Williams agglomerative merge loop (average / single
+linkage) driven by ``lax.fori_loop``.
+
+Both return a boolean *majority-cluster mask* rather than labels, because
+that is the only thing the aggregators consume (ref:
+fllib/aggregators/signguard.py:68-71 picks the larger cluster).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def sign_features(updates: jax.Array) -> jax.Array:
+    """SignGuard's per-client sign-statistics features (n, 3).
+
+    Fractions of positive / negative / zero coordinates per row
+    (ref: fllib/aggregators/signguard.py:52-59).
+    """
+    d = updates.shape[1]
+    return jnp.stack(
+        [
+            (updates > 0).sum(axis=1) / d,
+            (updates < 0).sum(axis=1) / d,
+            (updates == 0).sum(axis=1) / d,
+        ],
+        axis=1,
+    ).astype(updates.dtype)
+
+
+def kmeans_majority(features: jax.Array, num_iters: int = 10) -> jax.Array:
+    """2-means over ``features`` (n, f); True for points in the larger cluster.
+
+    Deterministic farthest-point initialisation (center 0 = point farthest
+    from the data mean, center 1 = point farthest from center 0) followed by
+    ``num_iters`` Lloyd steps.  Empty clusters keep their previous center.
+    """
+    mu = features.mean(axis=0)
+    c0 = features[jnp.argmax(jnp.linalg.norm(features - mu, axis=1))]
+    c1 = features[jnp.argmax(jnp.linalg.norm(features - c0, axis=1))]
+    centers = jnp.stack([c0, c1])
+
+    def assign(centers):
+        d = jnp.linalg.norm(features[:, None, :] - centers[None, :, :], axis=-1)
+        return jnp.argmin(d, axis=1)
+
+    def body(_, centers):
+        labels = assign(centers)
+        onehot = jax.nn.one_hot(labels, 2, dtype=features.dtype)  # (n, 2)
+        counts = onehot.sum(axis=0)  # (2,)
+        sums = onehot.T @ features  # (2, f)
+        new_centers = sums / jnp.maximum(counts, 1.0)[:, None]
+        return jnp.where((counts > 0)[:, None], new_centers, centers)
+
+    centers = lax.fori_loop(0, num_iters, body, centers)
+    labels = assign(centers)
+    in_one = labels == 1
+    n = features.shape[0]
+    # Reference keeps cluster "1" only on strict majority, else cluster "0"
+    # (ref: signguard.py:68).  Label numbering is arbitrary in sklearn; here
+    # the deterministic equivalent is: keep the strictly larger cluster,
+    # ties go to the cluster of point 0.
+    n_one = in_one.sum()
+    majority_is_one = jnp.where(2 * n_one == n, in_one[0], n_one > n - n_one)
+    return jnp.where(majority_is_one, in_one, ~in_one)
+
+
+@partial(jax.jit, static_argnames=("linkage",))
+def agglomerative_majority(dist: jax.Array, linkage: str = "average") -> jax.Array:
+    """2-cluster agglomerative clustering on a precomputed distance matrix.
+
+    ``dist`` is a symmetric (n, n) matrix.  Merges the closest pair n-2
+    times using Lance-Williams updates (average: size-weighted mean of
+    cluster-to-cluster distances; single: min), then returns the boolean
+    mask of points in the larger of the two remaining clusters (ties go to
+    the cluster containing point 0).
+    """
+    if linkage not in ("average", "single"):
+        raise ValueError(f"unsupported linkage: {linkage}")
+    n = dist.shape[0]
+    big = jnp.asarray(jnp.inf, dist.dtype)
+    eye = jnp.eye(n, dtype=bool)
+    D = jnp.where(eye, big, dist)
+    active = jnp.ones((n,), dtype=bool)
+    member = jnp.eye(n, dtype=bool)  # member[c, i]: point i currently in cluster c
+    sizes = jnp.ones((n,), dtype=dist.dtype)
+
+    def body(_, state):
+        D, active, member, sizes = state
+        flat = jnp.argmin(D)
+        r, c = flat // n, flat % n
+        a, b = jnp.minimum(r, c), jnp.maximum(r, c)
+        sa, sb = sizes[a], sizes[b]
+        if linkage == "average":
+            new_row = (sa * D[a] + sb * D[b]) / (sa + sb)
+        else:
+            new_row = jnp.minimum(D[a], D[b])
+        # Keep +inf against self and inactive clusters.
+        idx = jnp.arange(n)
+        dead = (~active) | (idx == a) | (idx == b)
+        new_row = jnp.where(dead, big, new_row)
+        D = D.at[a].set(new_row).at[:, a].set(new_row)
+        D = D.at[b].set(big).at[:, b].set(big)
+        member = member.at[a].set(member[a] | member[b])
+        member = member.at[b].set(jnp.zeros((n,), dtype=bool))
+        sizes = sizes.at[a].add(sb)
+        active = active.at[b].set(False)
+        return D, active, member, sizes
+
+    D, active, member, sizes = lax.fori_loop(0, n - 2, body, (D, active, member, sizes))
+    order = jnp.argsort(jnp.where(active, 0, 1), stable=True)
+    c0, c1 = order[0], order[1]  # the two surviving clusters (c0 contains point 0)
+    mask0, mask1 = member[c0], member[c1]
+    take1 = sizes[c1] > sizes[c0]
+    return jnp.where(take1, mask1, mask0)

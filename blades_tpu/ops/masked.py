@@ -1,0 +1,76 @@
+"""Masked reductions over stacked client-update matrices.
+
+The reference's selection-based aggregators (Krum, DnC, SignGuard,
+ClippedClustering) build Python lists of "benign" rows and aggregate those
+(e.g. ref: fllib/aggregators/signguard.py:65-73).  Under jit we cannot
+materialise a dynamically-sized subset, so every selection becomes a boolean
+mask over the client axis and aggregation becomes a masked reduction.  This
+keeps shapes static — the XLA-friendly formulation of the same math.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _nonempty(mask: jax.Array) -> jax.Array:
+    """Degrade an all-False selection to all-True.
+
+    A filter that rejects every client would otherwise propagate inf/0
+    silently under jit (the reference crashes on ``torch.stack([])`` in the
+    same situation, ref: fllib/aggregators/signguard.py:68-75; raising is
+    not expressible inside a compiled program, so the safe degradation is
+    "aggregate everyone").
+    """
+    return jnp.where(jnp.any(mask), mask, jnp.ones_like(mask))
+
+
+def masked_mean(x: jax.Array, mask: jax.Array) -> jax.Array:
+    """Mean over the rows of ``x`` (n, d) where ``mask`` (n,) is True.
+
+    An empty mask falls back to the mean of all rows (see ``_nonempty``).
+    """
+    w = _nonempty(mask).astype(x.dtype)
+    return (x * w[:, None]).sum(axis=0) / w.sum()
+
+
+def masked_median(x: jax.Array, mask: jax.Array) -> jax.Array:
+    """Symmetrized coordinate-wise median over selected rows.
+
+    Matches the reference's ``(median(x) - median(-x)) / 2`` construction
+    (ref: fllib/aggregators/aggregators.py:12-17): for an even number of
+    selected rows this is the midpoint of the two central order statistics,
+    for odd it is the central one.  Unselected rows are pushed to +inf so
+    they sort past the selected block.  An empty mask falls back to the
+    median of all rows (see ``_nonempty``).
+    """
+    mask = _nonempty(mask)
+    m = mask.sum()
+    xs = jnp.where(mask[:, None], x, jnp.inf)
+    xs = jnp.sort(xs, axis=0)
+    lo = jnp.take(xs, jnp.maximum(m - 1, 0) // 2, axis=0)
+    hi = jnp.take(xs, m // 2, axis=0)
+    return (lo + hi) / 2.0
+
+
+def median(x: jax.Array) -> jax.Array:
+    """Symmetrized coordinate-wise median over all rows of ``x`` (n, d)."""
+    return masked_median(x, jnp.ones(x.shape[0], dtype=bool))
+
+
+def clip_rows_to_norm(x: jax.Array, max_norm: jax.Array, eps: float = 1e-12) -> jax.Array:
+    """Scale each row of ``x`` (n, d) down to L2 norm ``max_norm`` if above it.
+
+    Row-wise analogue of the reference's ``clip_tensor_norm_``
+    (ref: fllib/utils/torch_utils.py:235-266) — pure instead of in-place.
+    """
+    norms = jnp.linalg.norm(x, axis=1, keepdims=True)
+    scale = jnp.minimum(1.0, max_norm / jnp.maximum(norms, eps))
+    return x * scale
+
+
+def clip_to_norm(v: jax.Array, max_norm: jax.Array, eps: float = 1e-12) -> jax.Array:
+    """Scale a single vector down to L2 norm ``max_norm`` if above it."""
+    norm = jnp.linalg.norm(v)
+    return v * jnp.minimum(1.0, max_norm / jnp.maximum(norm, eps))

@@ -1,0 +1,41 @@
+"""Pytree <-> flat-vector utilities.
+
+The TPU-native analogue of the reference's ``parameters_to_vector`` /
+``vector_to_parameters`` (ref: fllib/utils/torch_utils.py:126-200): client
+pseudo-gradients travel as flat ``(d,)`` vectors so aggregators are plain
+``(n, d) -> (d,)`` tensor programs.  Unlike torch, the unravel closure is
+built once from an example pytree and is jit-stable.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+
+def ravel_fn(example: Any) -> Tuple[Callable[[Any], jax.Array], Callable[[jax.Array], Any], int]:
+    """Build ``(ravel, unravel, d)`` for pytrees shaped like ``example``.
+
+    ``ravel(tree) -> (d,)`` concatenates all leaves; ``unravel(vec) -> tree``
+    inverts it.  Both are jittable and differentiable.
+    """
+    flat, unravel = ravel_pytree(example)
+    d = flat.size
+
+    def ravel(tree: Any) -> jax.Array:
+        return ravel_pytree(tree)[0]
+
+    return ravel, unravel, d
+
+
+def tree_size(tree: Any) -> int:
+    """Total number of scalar parameters in a pytree."""
+    return sum(x.size for x in jax.tree_util.tree_leaves(tree))
+
+
+def tree_zeros_like_flat(tree: Any) -> jax.Array:
+    """A flat zero vector with one slot per scalar in ``tree``."""
+    return jnp.zeros((tree_size(tree),), dtype=jnp.result_type(*jax.tree_util.tree_leaves(tree)))

@@ -1,0 +1,14 @@
+"""Test harness: force CPU JAX with 8 virtual devices.
+
+The TPU-native analogue of the reference's "multi-node simulation without a
+cluster" (SURVEY.md §4): multi-chip sharding tests run on a virtual 8-device
+CPU mesh via ``--xla_force_host_platform_device_count``.  Must run before
+jax is imported anywhere in the test process.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

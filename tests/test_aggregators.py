@@ -1,0 +1,215 @@
+"""Golden-value and property tests for the robust aggregators.
+
+Pattern (1) of the reference's test strategy (SURVEY.md §4): exact
+expectations on a small stacked update matrix, mirroring
+ref: fllib/aggregators/tests/test_aggregators.py where its expectations are
+valid, plus property tests (Weiszfeld optimality, outlier rejection) where
+the reference's expectations depend on torch RNG or are stale.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from blades_tpu.ops import (
+    Centeredclipping,
+    Clippedclustering,
+    DnC,
+    FLTrust,
+    GeoMed,
+    Mean,
+    Median,
+    Multikrum,
+    Signguard,
+    Trimmedmean,
+    get_aggregator,
+)
+
+RAW = jnp.array(
+    [
+        [1.0, 2.0, 3.0],
+        [-1.0, 4.0, -1.0],
+        [2.0, 2.0, 3.0],
+        [3.0, 1.0, 3.0],
+    ]
+)
+
+
+def run(agg, updates, state=None, key=None):
+    if state is None:
+        state = agg.init(updates.shape[1], updates.shape[0])
+    out, new_state = agg(updates, state, key=key)
+    return np.asarray(out), new_state
+
+
+def test_mean():
+    out, _ = run(Mean(), RAW)
+    np.testing.assert_allclose(out, [1.25, 2.25, 2.0], rtol=1e-6)
+
+
+def test_median():
+    out, _ = run(Median(), RAW)
+    np.testing.assert_allclose(out, [1.5, 2.0, 3.0], rtol=1e-6)
+
+
+def test_median_odd_count():
+    out, _ = run(Median(), RAW[:3])
+    np.testing.assert_allclose(out, [1.0, 2.0, 3.0], rtol=1e-6)
+
+
+def test_trimmedmean():
+    # 6 clients, f=1 -> num_excluded rounds up to 2: drop 2 high + 2 low per
+    # coordinate, mean the middle two.
+    x = jnp.array(
+        [
+            [0.0, 10.0],
+            [1.0, 20.0],
+            [2.0, 30.0],
+            [3.0, 40.0],
+            [4.0, 50.0],
+            [100.0, -100.0],
+        ]
+    )
+    out, _ = run(Trimmedmean(num_byzantine=1), x)
+    np.testing.assert_allclose(out, [2.5, 25.0], rtol=1e-6)
+
+
+def test_trimmedmean_num_excluded_rounds_up_to_even():
+    assert Trimmedmean(num_byzantine=3).num_excluded == 4
+    assert Trimmedmean(num_byzantine=2).num_excluded == 2
+    assert Trimmedmean(num_byzantine=3, filter_frac=0.5).num_excluded == 2
+
+
+def test_trimmedmean_too_few_clients_raises():
+    with pytest.raises(ValueError):
+        run(Trimmedmean(num_byzantine=2), RAW)
+
+
+def test_geomed_optimality_condition():
+    # Geometric-median characterization: unit vectors from the median to the
+    # points sum to ~0 (same check as the reference test).
+    out, _ = run(GeoMed(eps=1e-8, maxiter=1000, ftol=1e-22), RAW)
+    diffs = np.asarray(RAW) - out
+    units = diffs / np.linalg.norm(diffs, axis=1, keepdims=True)
+    np.testing.assert_allclose(units.sum(axis=0), np.zeros(3), atol=1e-3)
+
+
+def test_dnc_rejects_outlier():
+    key = jax.random.PRNGKey(0)
+    benign = jax.random.normal(key, (8, 32)) * 0.1
+    outlier = jnp.ones((2, 32)) * 50.0
+    x = jnp.concatenate([benign, outlier])
+    out, _ = run(DnC(num_byzantine=2, sub_dim=16, num_iters=3), x, key=key)
+    benign_mean = np.asarray(benign.mean(axis=0))
+    assert np.linalg.norm(out - benign_mean) < 1.0
+    assert np.abs(out).max() < 5.0
+
+
+def test_multikrum_picks_clustered_update():
+    rows = [[0.1 * i, 0.0] for i in range(5)] + [[100.0, 100.0]]
+    x = jnp.array(rows)
+    out, _ = run(Multikrum(num_byzantine=1, k=1), x)
+    # k=1 Krum returns one of the clustered updates, never the outlier.
+    assert np.abs(out).max() <= 1.0
+
+
+def test_multikrum_validates():
+    with pytest.raises(ValueError):
+        run(Multikrum(num_byzantine=2), RAW)
+
+
+def test_centeredclipping_large_tau_one_iter_is_mean():
+    agg = Centeredclipping(tau=1e9, n_iter=1)
+    out, new_state = run(agg, RAW)
+    np.testing.assert_allclose(out, np.asarray(RAW.mean(axis=0)), rtol=1e-6)
+    # The mean is a fixed point of clipping around itself...
+    out2, _ = agg(RAW, new_state)
+    np.testing.assert_allclose(np.asarray(out2), out, rtol=1e-5)
+    # ...but state carries: new data moves the center to the new mean.
+    out3, _ = agg(RAW * 3.0, new_state)
+    np.testing.assert_allclose(np.asarray(out3), 3.0 * np.asarray(RAW.mean(axis=0)), rtol=1e-5)
+
+
+def test_centeredclipping_small_tau_bounds_motion():
+    out, _ = run(Centeredclipping(tau=0.5, n_iter=1), RAW)
+    assert np.linalg.norm(out) <= 0.5 + 1e-6
+
+
+def test_signguard_filters_sign_flipped():
+    key = jax.random.PRNGKey(1)
+    benign = jax.random.normal(key, (7, 64)) * 0.1 + 0.05
+    malicious = -10.0 * jnp.ones((3, 64))
+    x = jnp.concatenate([benign, malicious])
+    out, _ = run(Signguard(), x)
+    benign_mean = np.asarray(benign.mean(axis=0))
+    assert np.linalg.norm(out - benign_mean) < np.linalg.norm(
+        np.asarray(x.mean(axis=0)) - benign_mean
+    )
+
+
+def test_clippedclustering_keeps_majority_cluster():
+    key = jax.random.PRNGKey(2)
+    benign = jax.random.normal(key, (7, 32)) * 0.1 + jnp.ones((32,))
+    malicious = jax.random.normal(key, (3, 32)) * 0.1 - jnp.ones((32,))
+    x = jnp.concatenate([benign, malicious])
+    agg = Clippedclustering(history_rounds=10)
+    state = agg.init(32, 10)
+    out, new_state = agg(x, state)
+    benign_mean = np.asarray(benign.mean(axis=0))
+    # Clipping rescales rows, so compare directions: the aggregate should
+    # point with the benign cluster, not the poisoned mean.
+    cos = out @ benign_mean / (np.linalg.norm(out) * np.linalg.norm(benign_mean))
+    assert cos > 0.95
+    assert int(new_state["count"]) == 10
+
+
+def test_fltrust_zeroes_negative_cosine():
+    server = jnp.ones((1, 4))
+    good = jnp.ones((2, 4)) * 2.0
+    bad = -jnp.ones((2, 4))
+    x = jnp.concatenate([good, bad, server])
+    out, _ = run(FLTrust(), x)
+    # Only the two positive-cosine clients contribute, rescaled to |server|.
+    np.testing.assert_allclose(out, np.ones(4), rtol=1e-5)
+
+
+def test_get_aggregator_injects_num_byzantine():
+    agg = get_aggregator("Trimmedmean", num_byzantine=3)
+    assert agg.num_byzantine == 3
+    agg = get_aggregator({"type": "Multikrum", "k": 2}, num_byzantine=1)
+    assert agg.num_byzantine == 1 and agg.k == 2
+    assert isinstance(get_aggregator("Mean"), Mean)
+    with pytest.raises(KeyError):
+        get_aggregator("Nope")
+
+
+@pytest.mark.parametrize(
+    "agg",
+    [
+        Mean(),
+        Median(),
+        Trimmedmean(num_byzantine=1),
+        GeoMed(),
+        DnC(num_byzantine=1, sub_dim=4, num_iters=2),
+        Multikrum(num_byzantine=1, k=2),
+        Centeredclipping(),
+        Signguard(),
+        Clippedclustering(history_rounds=4),
+    ],
+    ids=lambda a: a.name,
+)
+def test_aggregators_jit(agg):
+    # Every aggregator must run under jit with explicit threaded state.
+    x = jax.random.normal(jax.random.PRNGKey(3), (6, 16))
+    state = agg.init(16, 6)
+
+    @jax.jit
+    def step(updates, state, key):
+        return agg(updates, state, key=key)
+
+    out, new_state = step(x, state, jax.random.PRNGKey(0))
+    out2, _ = step(x, new_state, jax.random.PRNGKey(0))
+    assert np.asarray(out).shape == (16,)
+    assert np.all(np.isfinite(np.asarray(out)))
+    assert np.all(np.isfinite(np.asarray(out2)))

@@ -1,0 +1,73 @@
+"""Unit tests for the jittable clustering primitives that replace sklearn."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from blades_tpu.ops.clustering import agglomerative_majority, kmeans_majority
+
+
+def two_blobs(n_a=7, n_b=3, dim=3, sep=10.0, seed=0):
+    key = jax.random.PRNGKey(seed)
+    ka, kb = jax.random.split(key)
+    a = jax.random.normal(ka, (n_a, dim)) * 0.1
+    b = jax.random.normal(kb, (n_b, dim)) * 0.1 + sep
+    return jnp.concatenate([a, b])
+
+
+def test_kmeans_majority_finds_larger_blob():
+    pts = two_blobs()
+    mask = np.asarray(kmeans_majority(pts))
+    assert mask[:7].all() and not mask[7:].any()
+
+
+def test_kmeans_majority_jit():
+    pts = two_blobs()
+    mask = np.asarray(jax.jit(kmeans_majority)(pts))
+    assert mask.sum() == 7
+
+
+@pytest.mark.parametrize("linkage", ["average", "single"])
+def test_agglomerative_majority_two_blobs(linkage):
+    pts = two_blobs(n_a=6, n_b=4)
+    d = np.linalg.norm(np.asarray(pts)[:, None] - np.asarray(pts)[None, :], axis=-1)
+    mask = np.asarray(agglomerative_majority(jnp.asarray(d), linkage=linkage))
+    assert mask[:6].all() and not mask[6:].any()
+
+
+def test_agglomerative_majority_minimal_n2():
+    d = jnp.array([[0.0, 1.0], [1.0, 0.0]])
+    mask = np.asarray(agglomerative_majority(d))
+    # Two singletons: tie goes to the cluster containing point 0.
+    assert mask.tolist() == [True, False]
+
+
+def test_agglomerative_matches_scipy_average_linkage():
+    # Cross-check cluster assignment against a straightforward O(n^3)
+    # reference implementation of average-linkage on random points.
+    rng = np.random.default_rng(0)
+    pts = rng.normal(size=(12, 4))
+    d = np.linalg.norm(pts[:, None] - pts[None, :], axis=-1)
+
+    # Naive reference agglomerative clustering down to 2 clusters.
+    clusters = [[i] for i in range(12)]
+    while len(clusters) > 2:
+        best, pair = np.inf, None
+        for i in range(len(clusters)):
+            for j in range(i + 1, len(clusters)):
+                dd = np.mean([d[a, b] for a in clusters[i] for b in clusters[j]])
+                if dd < best:
+                    best, pair = dd, (i, j)
+        i, j = pair
+        clusters[i] = clusters[i] + clusters[j]
+        del clusters[j]
+    big = max(clusters, key=len)
+    expected = np.zeros(12, dtype=bool)
+    expected[big] = True
+    if len(clusters[0]) == len(clusters[1]):
+        expected = np.zeros(12, dtype=bool)
+        expected[[c for c in clusters if 0 in c][0]] = True
+
+    mask = np.asarray(agglomerative_majority(jnp.asarray(d), linkage="average"))
+    assert (mask == expected).all()
