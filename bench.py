@@ -1,0 +1,78 @@
+"""Benchmark: FL rounds/sec, FedAvg + ALIE + Median on CIFAR-10/ResNet-18.
+
+The BASELINE.json headline workload scaled to the available chip: N clients
+run vmapped local SGD on ResNet-18, ALIE forges the Byzantine lanes, the
+server aggregates with coordinate-wise Median.  Metric = full FL rounds/sec
+(local train + attack + robust aggregate + server step, all on device).
+
+``vs_baseline`` compares against the reference envelope: the Ray/GPU
+reference at its canonical 60-client CIFAR-10/ResNet config is bounded by
+per-round Python/actor overhead at ~1 round/sec on a single GPU (SURVEY.md
+§6: 2000 rounds is a multi-hour budget); the north-star asks ≥10x.  We
+report measured rounds/sec divided by that 1.0 round/sec envelope.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NUM_CLIENTS = 64
+NUM_BYZANTINE = 12
+BATCH = 32
+SHARD = 64
+ROUNDS = 20
+BASELINE_ROUNDS_PER_SEC = 1.0
+
+
+def main() -> None:
+    from blades_tpu.adversaries import get_adversary, make_malicious_mask
+    from blades_tpu.core import FedRound, Server, TaskSpec
+
+    task = TaskSpec(model="resnet18", input_shape=(32, 32, 3), num_classes=10,
+                    lr=0.1).build()
+    server = Server.from_config(aggregator="Median", lr=0.5)
+    adv = get_adversary("ALIE", num_clients=NUM_CLIENTS, num_byzantine=NUM_BYZANTINE)
+    fr = FedRound(task=task, server=server, adversary=adv, batch_size=BATCH,
+                  num_batches_per_round=1)
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(NUM_CLIENTS, SHARD, 32, 32, 3)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 10, size=(NUM_CLIENTS, SHARD)), jnp.int32)
+    lengths = jnp.full((NUM_CLIENTS,), SHARD, jnp.int32)
+    mal = make_malicious_mask(NUM_CLIENTS, NUM_BYZANTINE)
+
+    state = fr.init(jax.random.PRNGKey(0), NUM_CLIENTS)
+    step = jax.jit(fr.step, donate_argnums=(0,))
+
+    # Warmup / compile.
+    state, _ = step(state, x, y, lengths, mal, jax.random.PRNGKey(1))
+    jax.block_until_ready(state)
+
+    t0 = time.perf_counter()
+    for r in range(ROUNDS):
+        state, metrics = step(state, x, y, lengths, mal,
+                              jax.random.fold_in(jax.random.PRNGKey(2), r))
+    # Fetch a concrete value from the final round: forces the whole chain.
+    # (block_until_ready alone returns early through the axon tunnel.)
+    final_loss = float(metrics["train_loss"])
+    assert final_loss == final_loss  # NaN guard
+    dt = time.perf_counter() - t0
+
+    rounds_per_sec = ROUNDS / dt
+    print(json.dumps({
+        "metric": "fl_rounds_per_sec_fedavg_alie_median_cifar10_resnet18_64clients",
+        "value": round(rounds_per_sec, 3),
+        "unit": "rounds/s",
+        "vs_baseline": round(rounds_per_sec / BASELINE_ROUNDS_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -30,3 +30,14 @@ Package layout (mirrors the reference's layer map, SURVEY.md §1):
 __version__ = "0.1.0"
 
 from blades_tpu import ops as ops  # noqa: F401
+
+
+def __getattr__(name):
+    # Lazy subpackage access (keeps `import blades_tpu` light; models/data
+    # pull in flax/numpy loaders only when used).
+    import importlib
+
+    if name in ("adversaries", "algorithms", "core", "data", "models",
+                "parallel", "tune", "utils"):
+        return importlib.import_module(f"blades_tpu.{name}")
+    raise AttributeError(f"module 'blades_tpu' has no attribute {name!r}")

@@ -1,0 +1,80 @@
+"""Adversaries: Byzantine attacks as pure array programs (ref: blades/adversaries/).
+
+The reference's omniscient driver-side adversary mutates client results in
+place between the local rounds and the server step
+(ref: blades/adversaries/adversary.py:31-36, SURVEY.md §3.4).  Here the
+same two attack styles become:
+
+- **training-corruption** (LabelFlip, SignFlip): per-lane branchless hooks
+  inside the vmapped train step — ``jnp.where(malicious, attacked, benign)``.
+- **update-forging** (ALIE, IPM, Noise, MinMax, Adaptive, SignGuard-attack,
+  clipped-clustering-attack): a pure post-hook
+  ``on_updates_ready(updates, malicious, key, ...) -> updates`` that reads
+  benign statistics from the stacked ``(n, d)`` matrix and scatters forged
+  rows into the malicious lanes.
+
+Both run inside the same jit program as the round itself.
+"""
+
+from blades_tpu.adversaries.base import (  # noqa: F401
+    Adversary,
+    benign_mean_std,
+    make_malicious_mask,
+)
+from blades_tpu.adversaries.training_attacks import (  # noqa: F401
+    LabelFlipAdversary,
+    SignFlipAdversary,
+)
+from blades_tpu.adversaries.update_attacks import (  # noqa: F401
+    ALIEAdversary,
+    AdaptiveAdversary,
+    AttackclippedclusteringAdversary,
+    IPMAdversary,
+    MinMaxAdversary,
+    NoiseAdversary,
+    SignGuardAdversary,
+)
+
+ADVERSARIES = {
+    "ALIE": ALIEAdversary,
+    "IPM": IPMAdversary,
+    "LabelFlip": LabelFlipAdversary,
+    "SignFlip": SignFlipAdversary,
+    "Noise": NoiseAdversary,
+    "MinMax": MinMaxAdversary,
+    "Adaptive": AdaptiveAdversary,
+    "SignGuard": SignGuardAdversary,
+    "Attackclippedclustering": AttackclippedclusteringAdversary,
+}
+
+_ALIASES = {cls.__name__: cls for cls in ADVERSARIES.values()}
+
+
+def get_adversary(spec, **context) -> Adversary:
+    """Resolve an adversary from a name / ``{"type": ..., **kwargs}`` / instance,
+    mirroring the reference's ``from_config`` string resolution
+    (ref: blades/adversaries/adversary.py:56-85; YAML uses dotted class paths).
+
+    ``context`` supplies build-time knowledge the attack needs (``num_clients``,
+    ``num_byzantine``, ``num_classes``, ``aggregator_name``).
+    """
+    if spec is None:
+        return None
+    if isinstance(spec, Adversary):
+        return spec
+    if isinstance(spec, str):
+        spec = {"type": spec}
+    spec = dict(spec)
+    name = spec.pop("type")
+    # Accept dotted reference-style paths ("blades.adversaries.ALIEAdversary").
+    name = name.rsplit(".", 1)[-1]
+    cls = ADVERSARIES.get(name) or _ALIASES.get(name)
+    if cls is None:
+        raise KeyError(f"unknown adversary {name!r}; known: {sorted(ADVERSARIES)}")
+    import inspect
+
+    accepted = set(inspect.signature(cls).parameters)
+    for k, v in context.items():
+        if k in accepted and k not in spec:
+            spec[k] = v
+    return cls(**spec)

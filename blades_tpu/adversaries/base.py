@@ -1,0 +1,77 @@
+"""Adversary base class + benign-statistics helpers.
+
+API shape follows the reference's two hook points
+(ref: blades/adversaries/adversary.py:31-36) translated to pure functions;
+the malicious-client set is a boolean mask over the client axis instead of
+a mutated client list (ref: blades/clients/client.py:43-58's runtime
+``__class__`` swap has no array analogue — and needs none).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def make_malicious_mask(num_clients: int, num_byzantine: int) -> jnp.ndarray:
+    """First ``num_byzantine`` lanes are malicious (the reference marks the
+    first ``num_malicious_clients`` ids, ref: blades/algorithms/fedavg/
+    fedavg.py:160-167)."""
+    return jnp.arange(num_clients) < num_byzantine
+
+
+def benign_mean_std(
+    updates: jax.Array, malicious: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    """Mean and unbiased std over benign rows (torch ``std`` is ddof=1,
+    which is what every reference attack consumes)."""
+    w = (~malicious).astype(updates.dtype)
+    nb = jnp.maximum(w.sum(), 1.0)
+    mean = (updates * w[:, None]).sum(axis=0) / nb
+    var = ((updates - mean) ** 2 * w[:, None]).sum(axis=0) / jnp.maximum(nb - 1.0, 1.0)
+    return mean, jnp.sqrt(var)
+
+
+@dataclasses.dataclass(frozen=True)
+class Adversary:
+    """Base adversary: all hooks are identity.
+
+    Subclasses override some of:
+
+    - ``data_hook(x, y, malicious) -> (x, y)`` — runs inside the train step
+      per batch per lane (training-corruption attacks).
+    - ``grad_hook(grads, malicious) -> grads`` — runs after backward inside
+      the train step (training-corruption attacks).
+    - ``on_updates_ready(updates, malicious, key, *, aggregator,
+      global_params) -> updates`` — runs on the stacked update matrix before
+      aggregation (update-forging attacks, the omniscient-attacker model of
+      SURVEY.md §3.4).
+    """
+
+    def data_hook(self, x, y, malicious):
+        del malicious
+        return x, y
+
+    def grad_hook(self, grads, malicious):
+        del malicious
+        return grads
+
+    def on_updates_ready(self, updates, malicious, key, *, aggregator=None,
+                         global_params=None):
+        del key, aggregator, global_params, malicious
+        return updates
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+    @staticmethod
+    def scatter_forged(updates: jax.Array, forged: jax.Array,
+                       malicious: jax.Array) -> jax.Array:
+        """Overwrite malicious rows with ``forged`` ((d,) or (n, d))."""
+        if forged.ndim == 1:
+            forged = jnp.broadcast_to(forged[None, :], updates.shape)
+        return jnp.where(malicious[:, None], forged, updates)

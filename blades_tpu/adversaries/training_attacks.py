@@ -1,0 +1,33 @@
+"""Training-corruption attacks: per-lane branchless hooks inside the
+vmapped train step (SURVEY.md §7.3 "malicious behavior inside jit")."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from blades_tpu.adversaries.base import Adversary
+
+
+@dataclasses.dataclass(frozen=True)
+class LabelFlipAdversary(Adversary):
+    """Rewrite targets to ``num_classes - 1 - target`` on malicious lanes
+    (ref: blades/adversaries/labelflip_adversary.py:7-16); local training
+    stays on."""
+
+    num_classes: int = 10
+
+    def data_hook(self, x, y, malicious):
+        flipped = self.num_classes - 1 - y
+        return x, jnp.where(malicious, flipped, y)
+
+
+@dataclasses.dataclass(frozen=True)
+class SignFlipAdversary(Adversary):
+    """Negate every gradient leaf on malicious lanes after backward
+    (ref: blades/adversaries/signflip_adversary.py:7-15)."""
+
+    def grad_hook(self, grads, malicious):
+        return jax.tree.map(lambda g: jnp.where(malicious, -g, g), grads)

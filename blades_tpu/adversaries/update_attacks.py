@@ -1,0 +1,254 @@
+"""Update-forging attacks: pure post-hooks over the stacked update matrix.
+
+Each attack reads benign statistics (the omniscient-attacker model,
+SURVEY.md §3.4) and scatters a forged row into the malicious lanes — all
+inside the round's jit program.  Where the reference uses torch's global
+RNG, these take an explicit key.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from statistics import NormalDist
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from blades_tpu.adversaries.base import Adversary, benign_mean_std
+from blades_tpu.ops.aggregators import Signguard
+
+
+def _negate_first_half(v: jax.Array) -> jax.Array:
+    """SignGuard-evasion trick shared by ALIE and MinMax: negate the first
+    ``d // 2`` coordinates of the deviation (the reference's
+    ``random.sample(range(d // 2), d // 2)`` enumerates *all* of the first
+    half, ref: alie_adversary.py:34-39, minmax_adversary.py:45-52)."""
+    d = v.shape[0]
+    return jnp.where(jnp.arange(d) < d // 2, -v, v)
+
+
+@dataclasses.dataclass(frozen=True)
+class ALIEAdversary(Adversary):
+    """"A Little Is Enough" (ref: blades/adversaries/alie_adversary.py).
+
+    Forged update = benign_mean + z_max * benign_std where z_max is the
+    inverse normal CDF at ``(n - f - s) / (n - f)``, ``s = n//2 + 1 - f``
+    (ref: alie_adversary.py:17-26).  If the server runs SignGuard, the
+    first half of the std is negated (ref: :34-39).
+    """
+
+    num_clients: int = 60
+    num_byzantine: int = 0
+
+    @property
+    def z_max(self) -> float:
+        n, f = self.num_clients, self.num_byzantine
+        s = n // 2 + 1 - f
+        cdf = (n - f - s) / max(n - f, 1)
+        return NormalDist().inv_cdf(min(max(cdf, 1e-9), 1.0 - 1e-9))
+
+    def on_updates_ready(self, updates, malicious, key, *, aggregator=None,
+                         global_params=None):
+        del key, global_params
+        mean, std = benign_mean_std(updates, malicious)
+        if isinstance(aggregator, Signguard):
+            std = _negate_first_half(std)
+        forged = mean + std * self.z_max
+        return self.scatter_forged(updates, forged, malicious)
+
+
+@dataclasses.dataclass(frozen=True)
+class IPMAdversary(Adversary):
+    """Inner-product manipulation: forged = -scale * benign_mean
+    (ref: ipm_adversary.py:15-23).  Canonical scales 0.1 and 100."""
+
+    scale: float = 1.0
+
+    def on_updates_ready(self, updates, malicious, key, *, aggregator=None,
+                         global_params=None):
+        del key, aggregator, global_params
+        mean, _ = benign_mean_std(updates, malicious)
+        return self.scatter_forged(updates, -self.scale * mean, malicious)
+
+
+@dataclasses.dataclass(frozen=True)
+class NoiseAdversary(Adversary):
+    """Pure Gaussian noise rows N(mean, std), independent per malicious lane
+    (ref: noise_adversary.py:23-33)."""
+
+    mean: float = 0.1
+    std: float = 0.1
+
+    def on_updates_ready(self, updates, malicious, key, *, aggregator=None,
+                         global_params=None):
+        del aggregator, global_params
+        noise = self.mean + self.std * jax.random.normal(key, updates.shape,
+                                                         updates.dtype)
+        return jnp.where(malicious[:, None], noise, updates)
+
+
+@dataclasses.dataclass(frozen=True)
+class MinMaxAdversary(Adversary):
+    """Shejwalkar Min-Max (ref: minmax_adversary.py:37-63).
+
+    Binary-search gamma in [0, 5] so that the forged update
+    ``mean - gamma * std`` sits no farther from any benign update than the
+    max benign pairwise distance; ~9 bisection steps reach the reference's
+    0.01 tolerance, run as a fixed-iteration ``fori_loop``.  SignGuard-aware
+    (negates the first half of the deviation, ref: :45-52).
+    """
+
+    iters: int = 12
+
+    def on_updates_ready(self, updates, malicious, key, *, aggregator=None,
+                         global_params=None):
+        del key, global_params
+        mean, dev = benign_mean_std(updates, malicious)
+        if isinstance(aggregator, Signguard):
+            dev = _negate_first_half(dev)
+        benign = ~malicious
+        w = benign.astype(updates.dtype)
+        # Max pairwise distance among benign rows (masked).
+        sq = jnp.sum(updates**2, axis=1)
+        d2 = sq[:, None] + sq[None, :] - 2.0 * (updates @ updates.T)
+        pair_ok = w[:, None] * w[None, :]
+        threshold = jnp.sqrt(jnp.maximum((d2 * pair_ok).max(), 0.0))
+
+        def max_dist_to_benign(forged):
+            dist = jnp.linalg.norm(updates - forged[None, :], axis=1)
+            return jnp.where(benign, dist, -jnp.inf).max()
+
+        def body(_, lohi):
+            lo, hi = lohi
+            mid = (lo + hi) / 2.0
+            ok = max_dist_to_benign(mean - mid * dev) < threshold
+            return jnp.where(ok, mid, lo), jnp.where(ok, hi, mid)
+
+        lo, hi = lax.fori_loop(0, self.iters, body, (jnp.zeros(()), jnp.full((), 5.0)))
+        gamma = (lo + hi) / 2.0
+        return self.scatter_forged(updates, mean - gamma * dev, malicious)
+
+
+@dataclasses.dataclass(frozen=True)
+class AdaptiveAdversary(Adversary):
+    """Fang full-knowledge attack on median/trimmed-mean
+    (ref: adaptive_adversary.py:23-67).
+
+    Per coordinate with directed deviation ``s = sign(benign_mean)`` and
+    ``b = 2``: pick a random forged value just beyond the benign max (when
+    s = -1) or just below the benign min (when s = +1), with the sign-aware
+    interval endpoints of the reference's four masks.
+    """
+
+    b: float = 2.0
+
+    def on_updates_ready(self, updates, malicious, key, *, aggregator=None,
+                         global_params=None):
+        del aggregator, global_params
+        mean, _ = benign_mean_std(updates, malicious)
+        benign = (~malicious)[:, None]
+        mx = jnp.where(benign, updates, -jnp.inf).max(axis=0)
+        mn = jnp.where(benign, updates, jnp.inf).min(axis=0)
+        s = jnp.sign(mean)
+        b = self.b
+        r = jax.random.uniform(key, mean.shape, mean.dtype)
+        # The four sign-cases of ref: adaptive_adversary.py:33-56.
+        neg_pos = r * ((b - 1.0) * mx) + mx          # s=-1, max > 0
+        neg_neg = r * ((1.0 / b - 1.0) * mx) + mx    # s=-1, max < 0
+        pos_pos = r * ((1.0 - 1.0 / b) * mn) + mn / b  # s=+1, min > 0
+        pos_neg = r * ((1.0 - b) * mn) + mn * b      # s=+1, min < 0
+        forged = jnp.where(
+            s == -1.0,
+            jnp.where(mx > 0, neg_pos, neg_neg),
+            jnp.where(
+                s == 1.0,
+                jnp.where(mn > 0, pos_pos, pos_neg),
+                mean,  # s == 0
+            ),
+        )
+        return self.scatter_forged(updates, forged, malicious)
+
+
+@dataclasses.dataclass(frozen=True)
+class SignGuardAdversary(Adversary):
+    """Forge an update whose sign census matches the benign mean's but with
+    random magnitudes at shuffled positions (ref: signguard_adversary.py:39-67).
+
+    Implemented rank-wise: draw a random permutation rank per coordinate;
+    ranks below ``#pos`` become +U(0,1), the next ``#neg`` become -U(0,1),
+    the rest 0 — the same distribution as the reference's
+    ``hstack([rand(pos), -rand(neg), zeros(z)])[perm]``.
+    """
+
+    def on_updates_ready(self, updates, malicious, key, *, aggregator=None,
+                         global_params=None):
+        del aggregator, global_params
+        mean, _ = benign_mean_std(updates, malicious)
+        d = mean.shape[0]
+        k_perm, k_mag = jax.random.split(key)
+        pos = (mean > 0).sum()
+        neg = (mean < 0).sum()
+        rank = jax.random.permutation(k_perm, d)
+        u = jax.random.uniform(k_mag, (d,), mean.dtype)
+        forged = jnp.where(rank < pos, u, jnp.where(rank < pos + neg, -u, 0.0))
+        return self.scatter_forged(updates, forged, malicious)
+
+
+@dataclasses.dataclass(frozen=True)
+class AttackclippedclusteringAdversary(Adversary):
+    """Angle-chaining attack on clustering defenses
+    (ref: attackclippedclustering_adversary.py:24-97).
+
+    Single-linkage 2-cluster the benign cosine-distance matrix; let
+    ``theta_cross`` be the min pairwise angle minus 0.1 (the reference
+    computes the min over *all* pairs, ref: :45-53), ``u*`` the majority-
+    cluster member with max angle ``theta`` to the benign mean.  Forge
+    ``10 * (a * mean_hat + b * u*_hat)`` rotating past the cluster gap, or
+    ``-10 * mean`` if the chained angle exceeds pi (ref: :80-96).
+    """
+
+    eps: float = 1e-4
+
+    def on_updates_ready(self, updates, malicious, key, *, aggregator=None,
+                         global_params=None):
+        del key, aggregator, global_params
+        from blades_tpu.ops import clustering as C
+
+        benign = ~malicious
+        w = benign.astype(updates.dtype)
+        mean, _ = benign_mean_std(updates, malicious)
+        normed = updates / jnp.maximum(
+            jnp.linalg.norm(updates, axis=1, keepdims=True), 1e-12
+        )
+        cos = jnp.clip(normed @ normed.T, -1.0, 1.0)
+        dist = 1.0 - cos
+        n = updates.shape[0]
+        eye = jnp.eye(n, dtype=bool)
+        pair_ok = (w[:, None] * w[None, :] > 0) & ~eye
+        # Min pairwise cosine distance among benign rows (ref: :45-53).
+        dis_cross = jnp.where(pair_ok, dist, jnp.inf).min()
+        theta_cross = jnp.arccos(jnp.clip(1.0 - dis_cross, -1.0, 1.0)) - 0.1
+
+        # Majority cluster of benign rows under single linkage (ref: :54-58).
+        big_dist = jnp.where(pair_ok | eye, dist, 2.0)
+        majority = C.agglomerative_majority(big_dist, linkage="single") & benign
+
+        mean_hat = mean / jnp.maximum(jnp.linalg.norm(mean), 1e-12)
+        cos2mean = normed @ mean_hat
+        dis2mean = jnp.where(majority, 1.0 - cos2mean, -jnp.inf)
+        idx = jnp.argmax(dis2mean)
+        theta = jnp.arccos(jnp.clip(1.0 - dis2mean[idx], -1.0, 1.0))
+        # Guard tan(0): if the farthest majority row is parallel to the
+        # benign mean the chained rotation is degenerate; the clamped angle
+        # keeps a/b finite and the construction continuous.
+        theta = jnp.maximum(theta, 1e-3)
+        u_star = normed[idx]
+
+        ang = theta + theta_cross - self.eps
+        a = jnp.cos(ang) - jnp.sin(ang) / jnp.tan(theta)
+        b = jnp.cos(theta_cross - self.eps) + jnp.sin(theta_cross - self.eps) / jnp.tan(theta)
+        rotated = 10.0 * (a * mean_hat + b * u_star)
+        fallback = -10.0 * mean
+        forged = jnp.where(theta + theta_cross >= jnp.pi, fallback, rotated)
+        return self.scatter_forged(updates, forged, malicious)

@@ -1,0 +1,16 @@
+"""Algorithm layer: config system + FedAvg / FedAvg-DP drivers
+(ref: fllib/algorithms/ + blades/algorithms/).
+
+``FedavgConfig`` is the fluent builder (ref: fllib/algorithms/
+algorithm_config.py) — ``.data().training().client().adversary()
+.evaluation()`` then ``.build()`` — producing a ``Fedavg`` driver whose
+``train()`` runs one round (the Tune-Trainable ``step`` contract,
+ref: fllib/algorithms/algorithm.py:102-119) and whose checkpoints carry
+FULL state (params + server opt + aggregator + per-client opt + RNG),
+fixing the reference's config-only checkpoint gap (SURVEY.md §5).
+"""
+
+from blades_tpu.algorithms.config import FedavgConfig  # noqa: F401
+from blades_tpu.algorithms.fedavg import Fedavg  # noqa: F401
+from blades_tpu.algorithms.fedavg_dp import FedavgDPConfig  # noqa: F401
+from blades_tpu.algorithms.registry import ALGORITHMS, get_algorithm_class  # noqa: F401

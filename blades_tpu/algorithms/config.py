@@ -1,0 +1,245 @@
+"""Fluent algorithm config (ref: fllib/algorithms/algorithm_config.py).
+
+Same builder surface as the reference — ``.data() .training() .client()
+.adversary() .evaluation() .resources()`` each returning ``self``, a dict
+shim (``__getitem__``/``get``/``items``/``update_from_dict``) so YAML
+sweeps can treat configs as dicts, ``validate()`` + ``freeze()`` before
+``build()`` — but the payload drives the TPU stack: TaskSpec, Server,
+FedRound, mesh.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, Optional
+
+from blades_tpu.adversaries import get_adversary
+from blades_tpu.core import FedRound, Server, TaskSpec
+
+_INPUT_SHAPES = {
+    "mnist": (28, 28, 1),
+    "fashionmnist": (28, 28, 1),
+    "cifar10": (32, 32, 3),
+}
+
+
+class FedavgConfig:
+    """Builder for :class:`~blades_tpu.algorithms.fedavg.Fedavg`."""
+
+    def __init__(self, algo_class=None):
+        from blades_tpu.algorithms import fedavg as _fedavg
+
+        self.algo_class = algo_class or _fedavg.Fedavg
+        # data (ref: algorithm_config.py:54-96 defaults)
+        self.dataset: Any = "mnist"
+        self.num_clients: int = 10
+        self.iid: bool = True
+        self.dirichlet_alpha: float = 0.1
+        self.seed: int = 122  # canonical seed (ref: fedavg_dp.yaml:7-9)
+        # model/task
+        self.global_model: Any = "mlp"
+        self.num_classes: int = 10
+        self.input_shape: Optional[tuple] = None
+        # client training (ref: client_config.py)
+        self.client_lr: float = 0.1
+        self.client_momentum: float = 0.0
+        self.num_batch_per_round: int = 1  # ref: algorithm_config.py:63
+        self.train_batch_size: int = 32
+        # server (ref: server_config.py)
+        self.aggregator: Any = {"type": "Mean"}
+        self.server_lr: float = 0.1
+        self.server_momentum: float = 0.0
+        self.server_dampening: float = 0.0
+        self.server_weight_decay: float = 0.0
+        self.lr_schedule: Optional[list] = None
+        # adversary (ref: blades/algorithms/fedavg/fedavg.py:33-58)
+        self.num_malicious_clients: int = 0
+        self.adversary_config: Optional[Dict] = None
+        # evaluation (ref: algorithm_config.py evaluation_interval)
+        self.evaluation_interval: int = 50
+        # dp (ref: blades/clients/dp_client.py) — set via FedavgDPConfig
+        self.dp_clip_threshold: Optional[float] = None
+        self.dp_noise_factor: Optional[float] = None
+        # train-time augmentation; "auto" = by dataset (cifar10 -> crop+flip)
+        self.augment: Any = "auto"
+        # server root-dataset size for trust-bootstrapped aggregators (FLTrust)
+        self.fltrust_root_size: int = 100
+        # resources
+        self.num_devices: Optional[int] = None
+        self._frozen = False
+
+    # -- fluent setters ------------------------------------------------------
+
+    def _set(self, **kw):
+        if self._frozen:
+            raise RuntimeError("config is frozen (ref: algorithm_config.py freeze)")
+        for k, v in kw.items():
+            if v is not None:
+                setattr(self, k, v)
+        return self
+
+    def data(self, *, dataset=None, num_clients=None, iid=None,
+             dirichlet_alpha=None, seed=None):
+        return self._set(dataset=dataset, num_clients=num_clients, iid=iid,
+                         dirichlet_alpha=dirichlet_alpha, seed=seed)
+
+    def training(self, *, global_model=None, num_classes=None, input_shape=None,
+                 aggregator=None, server_lr=None, server_momentum=None,
+                 server_dampening=None, server_weight_decay=None,
+                 lr_schedule=None, num_batch_per_round=None,
+                 train_batch_size=None):
+        return self._set(
+            global_model=global_model, num_classes=num_classes,
+            input_shape=input_shape, aggregator=aggregator,
+            server_lr=server_lr, server_momentum=server_momentum,
+            server_dampening=server_dampening,
+            server_weight_decay=server_weight_decay, lr_schedule=lr_schedule,
+            num_batch_per_round=num_batch_per_round,
+            train_batch_size=train_batch_size,
+        )
+
+    def client(self, *, lr=None, momentum=None):
+        return self._set(client_lr=lr, client_momentum=momentum)
+
+    def adversary(self, *, num_malicious_clients=None, adversary_config=None):
+        return self._set(num_malicious_clients=num_malicious_clients,
+                         adversary_config=adversary_config)
+
+    def evaluation(self, *, evaluation_interval=None):
+        return self._set(evaluation_interval=evaluation_interval)
+
+    def resources(self, *, num_devices=None):
+        return self._set(num_devices=num_devices)
+
+    # -- dict shim (ref: algorithm_config.py:253-293,360-379) ----------------
+
+    _KEYS = None
+
+    def keys(self):
+        return [k for k in vars(self) if not k.startswith("_") and k != "algo_class"]
+
+    def __getitem__(self, k):
+        return getattr(self, k)
+
+    def get(self, k, default=None):
+        return getattr(self, k, default)
+
+    def items(self):
+        return [(k, getattr(self, k)) for k in self.keys()]
+
+    def update_from_dict(self, d: Dict[str, Any]) -> "FedavgConfig":
+        """Partial-dict merge (ref: algorithm_config.py:397-453).
+
+        Accepts both flat keys and the reference's YAML nesting
+        (``dataset_config``, ``client_config``, ``server_config``,
+        ``adversary_config``).
+        """
+        d = copy.deepcopy(dict(d))
+        nested_maps = {
+            "dataset_config": {"type": "dataset", "num_clients": "num_clients",
+                               "iid": "iid", "alpha": "dirichlet_alpha",
+                               "train_bs": "train_batch_size",
+                               "num_classes": "num_classes", "seed": "seed"},
+            "client_config": {"lr": "client_lr", "momentum": "client_momentum",
+                              "num_batch_per_round": "num_batch_per_round"},
+            "server_config": {"lr": "server_lr", "momentum": "server_momentum",
+                              "dampening": "server_dampening",
+                              "weight_decay": "server_weight_decay",
+                              "aggregator": "aggregator",
+                              "lr_schedule": "lr_schedule"},
+        }
+        for nk, mapping in nested_maps.items():
+            sub = d.pop(nk, None)
+            if sub:
+                for sk, sv in sub.items():
+                    if sk in mapping:
+                        setattr(self, mapping[sk], sv)
+                    else:
+                        raise KeyError(f"unknown {nk} key {sk!r}")
+        if "adversary_config" in d:
+            self.adversary_config = d.pop("adversary_config")
+        for k, v in d.items():
+            if k in self.keys():
+                setattr(self, k, v)
+            else:
+                raise KeyError(f"unknown config key {k!r}")
+        return self
+
+    # -- validation / build --------------------------------------------------
+
+    def validate(self) -> None:
+        """(ref: algorithm_config.py:295-315)"""
+        if self.num_malicious_clients > self.num_clients // 2:
+            raise ValueError(
+                f"num_malicious_clients={self.num_malicious_clients} is a "
+                f"majority of num_clients={self.num_clients}; Byzantine "
+                "robustness is undefined past 50%"
+            )
+        if self.num_malicious_clients > 0 and not self.adversary_config:
+            raise ValueError("num_malicious_clients > 0 requires adversary_config")
+        if self.input_shape is None:
+            name = self.dataset if isinstance(self.dataset, str) else getattr(
+                self.dataset, "name", None)
+            if isinstance(name, str) and name.lower() in _INPUT_SHAPES:
+                self.input_shape = _INPUT_SHAPES[name.lower()]
+            else:
+                raise ValueError(
+                    "input_shape could not be inferred; set "
+                    ".training(input_shape=...)"
+                )
+
+    def freeze(self) -> None:
+        self._frozen = True
+
+    def copy(self) -> "FedavgConfig":
+        c = copy.deepcopy(self)
+        c._frozen = False
+        return c
+
+    # sub-config factories (ref: algorithm_config.py:157-208)
+
+    def get_task_spec(self) -> TaskSpec:
+        augment = self.augment
+        if augment == "auto":
+            name = self.dataset if isinstance(self.dataset, str) else ""
+            augment = "cifar" if str(name).lower() == "cifar10" else None
+        return TaskSpec(
+            model=self.global_model, num_classes=self.num_classes,
+            input_shape=tuple(self.input_shape), lr=self.client_lr,
+            momentum=self.client_momentum, augment=augment,
+        )
+
+    def get_server(self) -> Server:
+        return Server.from_config(
+            aggregator=self.aggregator,
+            num_byzantine=self.num_malicious_clients,
+            lr=self.server_lr, momentum=self.server_momentum,
+            dampening=self.server_dampening,
+            weight_decay=self.server_weight_decay,
+            lr_schedule_points=self.lr_schedule,
+        )
+
+    def get_adversary(self):
+        return get_adversary(
+            self.adversary_config,
+            num_clients=self.num_clients,
+            num_byzantine=self.num_malicious_clients,
+            num_classes=self.num_classes,
+        )
+
+    def get_fed_round(self) -> FedRound:
+        return FedRound(
+            task=self.get_task_spec().build(),
+            server=self.get_server(),
+            adversary=self.get_adversary(),
+            batch_size=self.train_batch_size,
+            num_batches_per_round=self.num_batch_per_round,
+            dp_clip_threshold=self.dp_clip_threshold,
+            dp_noise_factor=self.dp_noise_factor,
+        )
+
+    def build(self):
+        """(ref: algorithm_config.py:222-251)"""
+        self.validate()
+        self.freeze()
+        return self.algo_class(self)

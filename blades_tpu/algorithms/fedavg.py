@@ -1,0 +1,189 @@
+"""Fedavg driver (ref: blades/algorithms/fedavg/fedavg.py + fllib
+Algorithm).
+
+The Tune-Trainable surface — ``train()`` per round with periodic
+evaluation folded into the result dict, ``save_checkpoint``/
+``load_checkpoint``, frozen config — without the Trainable inheritance:
+this class IS the trainable the sweep runner drives.
+
+Setup replaces the reference's actor/dataset choreography
+(ref: fedavg.py:127-201) with: build dataset arrays, build the FedRound
+program, optionally shard it over a mesh, jit once.  Checkpoints carry
+FULL state — params, server optimizer, aggregator state, stacked client
+optimizer states, round counter, RNG key — fixing the reference's
+config-only ``__getstate__`` gap (ref: fllib/algorithms/algorithm.py:206-219,
+SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import pickle
+import time
+from pathlib import Path
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from blades_tpu.adversaries import make_malicious_mask
+from blades_tpu.core import FedRound
+from blades_tpu.data import DatasetCatalog
+from blades_tpu.utils.timers import Timers
+
+
+class Fedavg:
+    """FedAvg with Byzantine clients and a robust server."""
+
+    def __init__(self, config):
+        self.config = config
+        self._setup()
+
+    # -- setup (ref: fedavg.py:127-201) -------------------------------------
+
+    def _setup(self) -> None:
+        cfg = self.config
+        self.dataset = DatasetCatalog.get_dataset(
+            cfg.dataset, num_clients=cfg.num_clients, iid=cfg.iid,
+            alpha=cfg.dirichlet_alpha, seed=cfg.seed,
+        )
+        self.fed_round: FedRound = cfg.get_fed_round()
+        if getattr(self.fed_round.server.aggregator, "expects_trusted_row", False):
+            self.fed_round = self._attach_root_data(self.fed_round)
+        self.malicious = make_malicious_mask(cfg.num_clients,
+                                             cfg.num_malicious_clients)
+        self._key = jax.random.PRNGKey(cfg.seed)
+        init_key, self._key = jax.random.split(self._key)
+        self.state = self.fed_round.init(init_key, cfg.num_clients)
+
+        self._train_arrays = (
+            jnp.asarray(self.dataset.train.x),
+            jnp.asarray(self.dataset.train.y),
+            jnp.asarray(self.dataset.train.lengths),
+        )
+        self._test_arrays = (
+            jnp.asarray(self.dataset.test.x),
+            jnp.asarray(self.dataset.test.y),
+            jnp.asarray(self.dataset.test.lengths),
+        )
+
+        self.mesh = None
+        if cfg.num_devices and cfg.num_devices > 1:
+            from blades_tpu.parallel import make_mesh, shard_federation, sharded_step
+            from blades_tpu.parallel.sharded import sharded_evaluate
+
+            self.mesh = make_mesh(num_devices=cfg.num_devices)
+            self.state, arrays = shard_federation(
+                self.mesh, self.state, self._train_arrays + (self.malicious,)
+            )
+            self._train_arrays, self.malicious = arrays[:3], arrays[3]
+            _, self._test_arrays = shard_federation(
+                self.mesh, self.state, self._test_arrays
+            )
+            self._step = sharded_step(self.fed_round, self.mesh, donate=False)
+            self._evaluate = sharded_evaluate(self.fed_round, self.mesh)
+        else:
+            self._step = jax.jit(self.fed_round.step)
+            self._evaluate = jax.jit(self.fed_round.evaluate)
+
+        self.timers = Timers()
+        self._iteration = 0
+        self._last_eval: Dict = {}
+
+    def _attach_root_data(self, fed_round: FedRound) -> FedRound:
+        """Carve a clean server root dataset for FLTrust (Cao et al.): a few
+        rows from every client's training shard, round-robin, up to
+        ``fltrust_root_size`` samples."""
+        import dataclasses
+
+        import numpy as np
+
+        part = self.dataset.train
+        per = max(1, -(-self.config.fltrust_root_size // part.num_clients))
+        take = [min(per, int(part.lengths[i])) for i in range(part.num_clients)]
+        tx = np.concatenate([part.x[i, : take[i]] for i in range(part.num_clients)])
+        ty = np.concatenate([part.y[i, : take[i]] for i in range(part.num_clients)])
+        tx = tx[: self.config.fltrust_root_size]
+        ty = ty[: self.config.fltrust_root_size]
+        return dataclasses.replace(
+            fed_round, trusted_data=(jnp.asarray(tx), jnp.asarray(ty))
+        )
+
+    # -- Trainable surface (ref: algorithm.py:102-119) ----------------------
+
+    @property
+    def iteration(self) -> int:
+        return self._iteration
+
+    def train(self) -> Dict:
+        """One FL round + periodic eval, returns the round's result dict."""
+        round_key, self._key = jax.random.split(self._key)
+        with self.timers.time("training_step"):
+            self.state, metrics = self._step(
+                self.state, *self._train_arrays, self.malicious, round_key
+            )
+            # Concrete fetches inside the timer: block_until_ready alone can
+            # return early through remote-execution tunnels.
+            metrics = {k: float(v) for k, v in metrics.items()}
+        self._iteration += 1
+        result = {
+            "training_iteration": self._iteration,
+            "train_loss": metrics["train_loss"],
+            "agg_norm": metrics["agg_norm"],
+            "update_norm_mean": metrics["update_norm_mean"],
+            "timers": self.timers.summary(),
+        }
+        if self.config.evaluation_interval and (
+            self._iteration % self.config.evaluation_interval == 0
+        ):
+            result.update(self.evaluate())
+        elif self._last_eval:
+            result.update(self._last_eval)
+        return result
+
+    def evaluate(self) -> Dict:
+        """Weighted per-client evaluation (ref: fedavg.py:247-279)."""
+        with self.timers.time("evaluate"):
+            ev = self._evaluate(self.state, *self._test_arrays)
+            self._last_eval = {
+                "test_loss": float(ev["test_loss"]),
+                "test_acc": float(ev["test_acc"]),
+                "test_acc_top3": float(ev["test_acc_top3"]),
+            }
+        return dict(self._last_eval)
+
+    # -- checkpointing (full state; fixes ref gap SURVEY.md §5) --------------
+
+    def save_checkpoint(self, checkpoint_dir: str) -> str:
+        path = Path(checkpoint_dir)
+        path.mkdir(parents=True, exist_ok=True)
+        payload = {
+            "iteration": self._iteration,
+            "key": jax.device_get(self._key),
+            "state": jax.device_get(self.state),
+            "config_dict": {k: v for k, v in self.config.items()
+                            if not callable(v)},
+        }
+        file = path / "algorithm_state.pkl"
+        with open(file, "wb") as f:
+            pickle.dump(payload, f)
+        return str(file)
+
+    def load_checkpoint(self, checkpoint_path: str) -> None:
+        p = Path(checkpoint_path)
+        if p.is_dir():
+            p = p / "algorithm_state.pkl"
+        with open(p, "rb") as f:
+            payload = pickle.load(f)
+        self._iteration = payload["iteration"]
+        self._key = jnp.asarray(payload["key"])
+        state = jax.tree.map(jnp.asarray, payload["state"])
+        if self.mesh is not None:
+            from blades_tpu.parallel import shard_federation
+
+            state, _ = shard_federation(self.mesh, state, ())
+        self.state = state
+
+    # -- misc ---------------------------------------------------------------
+
+    def stop(self) -> None:
+        pass

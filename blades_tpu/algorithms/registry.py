@@ -1,0 +1,36 @@
+"""Algorithm registry (ref: blades/algorithms/registry.py:22-50)."""
+
+from __future__ import annotations
+
+from typing import Tuple, Type
+
+
+def _fedavg():
+    from blades_tpu.algorithms.config import FedavgConfig
+    from blades_tpu.algorithms.fedavg import Fedavg
+
+    return Fedavg, FedavgConfig
+
+
+def _fedavg_dp():
+    from blades_tpu.algorithms.fedavg import Fedavg
+    from blades_tpu.algorithms.fedavg_dp import FedavgDPConfig
+
+    return Fedavg, FedavgDPConfig
+
+
+ALGORITHMS = {
+    "FEDAVG": _fedavg,
+    "FEDAVG_DP": _fedavg_dp,
+}
+
+
+def get_algorithm_class(name: str, return_config: bool = False):
+    """(ref: registry.py:28-50)"""
+    key = name.upper()
+    if key not in ALGORITHMS:
+        raise KeyError(f"unknown algorithm {name!r}; known: {sorted(ALGORITHMS)}")
+    cls, cfg_cls = ALGORITHMS[key]()
+    if return_config:
+        return cls, cfg_cls()
+    return cls

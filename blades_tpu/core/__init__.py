@@ -1,0 +1,19 @@
+"""Core train-step layer (ref: fllib/clients, fllib/tasks, fllib/algorithms/server.py).
+
+The reference's Client/Task/Server object graph — per-client torch
+optimizers swapped in and out of a shared model (ref:
+fllib/core/execution/worker.py:66-74), pseudo-gradients via state-dict
+snapshots (ref: fllib/tasks/task.py:159-186) — collapses here into three
+pure functions over stacked arrays:
+
+- :func:`blades_tpu.core.task.local_round` — one client's local SGD round
+  as a ``lax.scan``; the pseudo-gradient is the functional diff
+  ``ravel(new_params) - ravel(global_params)`` (no snapshot/deepcopy).
+- ``vmap(local_round)`` — the whole federation's round; per-client
+  optimizer state is a stacked pytree, "switch_client" is an array index.
+- :func:`blades_tpu.core.server.server_step` — aggregate + optax update.
+"""
+
+from blades_tpu.core.task import Task, TaskSpec  # noqa: F401
+from blades_tpu.core.server import Server, ServerState  # noqa: F401
+from blades_tpu.core.round import FedRound, RoundState  # noqa: F401

@@ -1,0 +1,173 @@
+"""Server: robust aggregate + optax step (ref: fllib/algorithms/server.py).
+
+The reference server writes ``-aggregate`` into each parameter's ``.grad``
+slice-by-slice and runs a torch SGD with an RLlib piecewise-linear LR
+schedule (ref: server.py:100-130, :43-50).  Here the same fixed point is an
+optax transform applied to the negated aggregate: ``params_{t+1} =
+opt(params_t, grad=-agg)``, with the schedule an optax
+``piecewise_interpolate_schedule`` over rounds.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from blades_tpu.ops.aggregators import Aggregator, get_aggregator
+from blades_tpu.utils.tree import ravel_fn
+
+
+def lr_schedule(
+    lr: float, schedule: Optional[Sequence[Tuple[int, float]]]
+) -> optax.Schedule:
+    """RLlib-style piecewise-linear schedule ``[[round, lr], ...]``
+    (ref: fllib/algorithms/server.py:43-50; YAML ``lr_schedule``)."""
+    if not schedule:
+        return optax.constant_schedule(lr)
+    pts = sorted((int(r), float(v)) for r, v in schedule)
+    if pts[0][0] != 0:
+        pts.insert(0, (0, lr))
+    init = pts[0][1]
+    boundaries_and_scales = {}
+    # piecewise_interpolate_schedule multiplies; express values as ratios.
+    prev = init
+    for r, v in pts[1:]:
+        boundaries_and_scales[r] = v / prev if prev != 0 else 0.0
+        prev = v
+    return optax.piecewise_interpolate_schedule(
+        "linear", init_value=init, boundaries_and_scales=boundaries_and_scales
+    )
+
+
+def _torch_momentum(momentum: float, dampening: float = 0.0) -> optax.GradientTransformation:
+    """torch.optim.SGD momentum semantics: ``buf = m*buf + (1-dampening)*g``
+    with the first step seeding ``buf = g`` undamped
+    (the server config exposes ``dampening``, ref: fllib/algorithms/
+    server_config.py; optax.trace has no dampening term)."""
+
+    def init(params):
+        return {
+            "buf": jax.tree.map(jnp.zeros_like, params),
+            "step": jnp.zeros((), jnp.int32),
+        }
+
+    def update(updates, state, params=None):
+        del params
+        first = state["step"] == 0
+        scale = jnp.where(first, 1.0, 1.0 - dampening)
+        buf = jax.tree.map(
+            lambda b, g: momentum * b + scale * g, state["buf"], updates
+        )
+        return buf, {"buf": buf, "step": state["step"] + 1}
+
+    return optax.GradientTransformation(init, update)
+
+
+@dataclasses.dataclass
+class ServerState:
+    """Replicated global state threaded through rounds (a pytree)."""
+
+    params: Any
+    opt_state: Any
+    agg_state: Any
+    round: jax.Array  # scalar int32
+
+
+jax.tree_util.register_pytree_node(
+    ServerState,
+    lambda s: ((s.params, s.opt_state, s.agg_state, s.round), None),
+    lambda _, c: ServerState(*c),
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Server:
+    """Static server config: optimizer + aggregator (ref: server_config.py)."""
+
+    aggregator: Aggregator
+    lr: float = 0.1
+    momentum: float = 0.0
+    dampening: float = 0.0
+    weight_decay: float = 0.0
+    schedule: Optional[Tuple[Tuple[int, float], ...]] = None
+
+    @staticmethod
+    def from_config(
+        aggregator="Mean",
+        num_byzantine: Optional[int] = None,
+        lr: float = 0.1,
+        momentum: float = 0.0,
+        dampening: float = 0.0,
+        weight_decay: float = 0.0,
+        lr_schedule_points=None,
+    ) -> "Server":
+        agg = get_aggregator(aggregator, num_byzantine=num_byzantine)
+        sched = tuple(tuple(p) for p in lr_schedule_points) if lr_schedule_points else None
+        return Server(agg, lr, momentum, dampening, weight_decay, sched)
+
+    def optimizer(self) -> optax.GradientTransformation:
+        sched = lr_schedule(self.lr, self.schedule)
+        tx = []
+        if self.weight_decay:
+            tx.append(optax.add_decayed_weights(self.weight_decay))
+        if self.momentum:
+            tx.append(_torch_momentum(self.momentum, self.dampening))
+        tx.append(optax.scale_by_learning_rate(sched))
+        return optax.chain(*tx)
+
+    def init(self, params, num_clients: int) -> ServerState:
+        ravel, _, d = ravel_fn(params)
+        return ServerState(
+            params=params,
+            opt_state=self.optimizer().init(params),
+            agg_state=self.aggregator.init(d, num_clients),
+            round=jnp.zeros((), jnp.int32),
+        )
+
+    def step(
+        self,
+        state: ServerState,
+        updates: jax.Array,
+        *,
+        key: Optional[jax.Array] = None,
+        trusted_update: Optional[jax.Array] = None,
+    ) -> Tuple[ServerState, jax.Array]:
+        """Aggregate the ``(n, d)`` update matrix and apply one server-opt step.
+
+        Returns ``(new_state, aggregate)``.  Matches the reference fixed
+        point: aggregate is an *update direction*, the optimizer descends
+        on ``-aggregate`` (ref: server.py:109-130).
+
+        ``trusted_update`` is the server's own root-data update, required by
+        trust-bootstrapped aggregators (FLTrust) and appended as the final
+        row of the matrix; passing a plain client matrix to FLTrust would
+        make the last *client* the root of trust, so that is rejected.
+        """
+        if getattr(self.aggregator, "expects_trusted_row", False):
+            if trusted_update is None:
+                raise ValueError(
+                    f"{self.aggregator.name} requires trusted_update= (the "
+                    "server's root-data update); without it the last client "
+                    "row would silently become the root of trust"
+                )
+            updates = jnp.concatenate([updates, trusted_update[None, :]], axis=0)
+        ravel, unravel, _ = ravel_fn(state.params)
+        agg, agg_state = self.aggregator(updates, state.agg_state, key=key)
+        grads = unravel(-agg)
+        opt_updates, opt_state = self.optimizer().update(
+            grads, state.opt_state, state.params
+        )
+        params = optax.apply_updates(state.params, opt_updates)
+        return (
+            ServerState(
+                params=params,
+                opt_state=opt_state,
+                agg_state=agg_state,
+                round=state.round + 1,
+            ),
+            agg,
+        )

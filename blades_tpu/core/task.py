@@ -1,0 +1,194 @@
+"""Task = model + loss + metrics + the local-SGD round (ref: fllib/tasks/task.py).
+
+A ``Task`` binds a flax module to a loss and exposes pure functions:
+
+- ``init`` — parameters + per-client optimizer state.
+- ``train_one_batch`` — one SGD step (ref: task.py:170-186's
+  zero_grad/forward/backward/step, as one ``value_and_grad`` step).
+- ``local_round`` — ``num_batches`` steps via ``lax.scan``; returns the
+  flat pseudo-gradient ``ravel(params_end) - ravel(params_start)`` (the
+  sign convention is "update direction": the server *adds* the aggregate,
+  ref: fllib/algorithms/server.py:109-130 writes ``-agg`` into ``.grad``
+  and lets SGD subtract it — same fixed point).
+- ``evaluate`` — summed cross-entropy + top-k accuracies over a client's
+  test shard (ref: task.py:104-121, 188-202), masked for padding.
+
+Adversary interposition happens through two per-lane hooks threaded into
+the scan — ``data_hook(x, y, malicious)`` (label-flip style, ref:
+blades/adversaries/labelflip_adversary.py:10-16) and
+``grad_hook(grads, malicious)`` (sign-flip style, ref:
+signflip_adversary.py:9-15).  Both are branchless: they apply
+``jnp.where(malicious, attacked, benign)`` so the whole federation stays
+one jit program (SURVEY.md §7.3).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+
+from blades_tpu.models.catalog import ModelCatalog
+from blades_tpu.utils.tree import ravel_fn
+
+# Per-lane hooks: (x, y, malicious_flag) -> (x, y)  /  (grads_pytree, flag) -> grads
+DataHook = Callable[[jax.Array, jax.Array, jax.Array], Tuple[jax.Array, jax.Array]]
+GradHook = Callable[[Any, jax.Array], Any]
+
+
+def identity_data_hook(x, y, malicious):
+    del malicious
+    return x, y
+
+
+def identity_grad_hook(grads, malicious):
+    del malicious
+    return grads
+
+
+@dataclasses.dataclass(frozen=True)
+class TaskSpec:
+    """Declarative task config (ref: fllib/tasks/task.py:32-71)."""
+
+    model: Any = "mlp"
+    num_classes: int = 10
+    input_shape: Tuple[int, ...] = (28, 28, 1)
+    lr: float = 0.1
+    momentum: float = 0.0
+    loss_clamp: float = 1e6  # ref: fllib/tasks/mnist.py:12-14 clamps CE to [0, 1e6]
+    # Keyed train-time augmentation ("cifar" = random crop + flip, the
+    # reference's loader transforms, ref: fllib/datasets/cifar10.py:56-64).
+    augment: Any = None
+
+    def build(self) -> "Task":
+        model = ModelCatalog.get_model(self.model, num_classes=self.num_classes)
+        return Task(spec=self, model=model)
+
+
+@dataclasses.dataclass(frozen=True)
+class Task:
+    spec: TaskSpec
+    model: nn.Module
+
+    # -- construction -------------------------------------------------------
+
+    def client_optimizer(self) -> optax.GradientTransformation:
+        """Per-client SGD (ref: fllib/clients/client_config.py lr/momentum)."""
+        if self.spec.momentum:
+            return optax.sgd(self.spec.lr, momentum=self.spec.momentum)
+        return optax.sgd(self.spec.lr)
+
+    def init_params(self, key: jax.Array):
+        x = jnp.zeros((1,) + self.spec.input_shape, jnp.float32)
+        return self.model.init({"params": key, "dropout": key}, x)["params"]
+
+    def init_client_opt_state(self, params):
+        return self.client_optimizer().init(params)
+
+    # -- pure compute -------------------------------------------------------
+
+    def apply(self, params, x, *, train: bool = False, dropout_key=None):
+        rngs = {"dropout": dropout_key} if dropout_key is not None else None
+        return self.model.apply({"params": params}, x, train=train, rngs=rngs)
+
+    def loss_fn(self, params, x, y, dropout_key=None):
+        logits = self.apply(params, x, train=True, dropout_key=dropout_key)
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+        return jnp.clip(ce, 0.0, self.spec.loss_clamp)
+
+    def train_one_batch(
+        self,
+        params,
+        opt_state,
+        x,
+        y,
+        key,
+        malicious,
+        data_hook: DataHook = identity_data_hook,
+        grad_hook: GradHook = identity_grad_hook,
+    ):
+        """One local SGD step with adversary hooks (ref: task.py:170-186).
+
+        Order matches the reference loader->callback pipeline: augmentation
+        first (DataLoader transform), then the adversary's data hook
+        (``on_train_batch_begin``).
+        """
+        from blades_tpu.data.augment import get_augmentation
+
+        aug = get_augmentation(self.spec.augment)
+        if aug is not None:
+            k_aug, key = jax.random.split(key)
+            x = aug(k_aug, x)
+        x, y = data_hook(x, y, malicious)
+        loss, grads = jax.value_and_grad(self.loss_fn)(params, x, y, key)
+        grads = grad_hook(grads, malicious)
+        updates, opt_state = self.client_optimizer().update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    def local_round(
+        self,
+        global_params,
+        opt_state,
+        batches_x,
+        batches_y,
+        key,
+        malicious,
+        data_hook: DataHook = identity_data_hook,
+        grad_hook: GradHook = identity_grad_hook,
+    ):
+        """One client's full local round: scan SGD over ``num_batches``.
+
+        Args:
+            global_params: the round's incoming global params pytree.
+            opt_state: this client's optimizer state (stacked outside).
+            batches_x/batches_y: ``(num_batches, batch, ...)`` presampled.
+            key: per-client PRNG key (dropout etc.).
+            malicious: scalar bool — this lane's malicious flag.
+
+        Returns:
+            ``(update_vec, new_opt_state, mean_loss)`` where ``update_vec`` is
+            the flat pseudo-gradient (ref: task.py:162-168, functionally).
+        """
+        ravel, _, _ = ravel_fn(global_params)
+        num_batches = batches_x.shape[0]
+        keys = jax.random.split(key, num_batches)
+
+        def step(carry, inp):
+            params, opt_state = carry
+            x, y, k = inp
+            params, opt_state, loss = self.train_one_batch(
+                params, opt_state, x, y, k, malicious, data_hook, grad_hook
+            )
+            return (params, opt_state), loss
+
+        (params, opt_state), losses = jax.lax.scan(
+            step, (global_params, opt_state), (batches_x, batches_y, keys)
+        )
+        update = ravel(params) - ravel(global_params)
+        return update, opt_state, losses.mean()
+
+    def evaluate(self, params, x, y, mask):
+        """Masked eval over one client's padded test shard.
+
+        Returns summed-CE loss, top-1/top-3 correct counts, and the sample
+        count — so the driver can do the reference's weighted average
+        (ref: blades/algorithms/fedavg/fedavg.py:268-277).
+        """
+        logits = self.apply(params, x, train=False)
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+        m = mask.astype(jnp.float32)
+        top1 = (jnp.argmax(logits, -1) == y).astype(jnp.float32)
+        k = min(3, logits.shape[-1])
+        topk_idx = jax.lax.top_k(logits, k)[1]
+        topk = jnp.any(topk_idx == y[:, None], axis=-1).astype(jnp.float32)
+        return {
+            "ce_sum": (ce * m).sum(),
+            "top1_sum": (top1 * m).sum(),
+            "top3_sum": (topk * m).sum(),
+            "count": m.sum(),
+        }

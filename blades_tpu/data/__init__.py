@@ -1,0 +1,21 @@
+"""Data layer: client partitioning + dataset catalog (ref: fllib/datasets/).
+
+The reference partitions numpy arrays into per-client Subsets held inside
+Ray actors (ref: fllib/datasets/fldataset.py:159-228).  Here partitioning
+produces rectangular device arrays ``(num_clients, max_shard, ...)`` plus a
+per-client length vector, so the whole federation is one stacked pytree that
+``vmap``/``shard_map`` can split over chips.
+"""
+
+from blades_tpu.data.partition import (  # noqa: F401
+    Partition,
+    dirichlet_partition,
+    iid_partition,
+    partition_dataset,
+)
+from blades_tpu.data.datasets import (  # noqa: F401
+    DatasetCatalog,
+    FLDataset,
+    register_dataset,
+)
+from blades_tpu.data.sampler import sample_batch, sample_client_batches  # noqa: F401

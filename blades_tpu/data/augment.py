@@ -1,0 +1,48 @@
+"""Keyed train-time augmentation as pure jax ops.
+
+The reference augments CIFAR-10 training batches in the DataLoader with
+RandomCrop(32, padding=4) + RandomHorizontalFlip
+(ref: fllib/datasets/cifar10.py:56-64).  Under jit, augmentation is a pure
+function of a PRNG key applied inside the train step, per sample.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def random_crop_flip(key: jax.Array, x: jax.Array, padding: int = 4) -> jax.Array:
+    """Per-sample random shift-crop (zero padding) + horizontal flip.
+
+    ``x`` is a batch ``(B, H, W, C)``; each sample gets its own offsets and
+    flip bit.
+    """
+    b, h, w, c = x.shape
+    k_off, k_flip = jax.random.split(key)
+    offs = jax.random.randint(k_off, (b, 2), 0, 2 * padding + 1)
+    flips = jax.random.bernoulli(k_flip, 0.5, (b,))
+    padded = jnp.pad(
+        x, ((0, 0), (padding, padding), (padding, padding), (0, 0))
+    )
+
+    def one(img, off, flip):
+        img = jax.lax.dynamic_slice(img, (off[0], off[1], 0), (h, w, c))
+        return jnp.where(flip, img[:, ::-1, :], img)
+
+    return jax.vmap(one)(padded, offs, flips)
+
+
+AUGMENTATIONS = {
+    None: None,
+    "none": None,
+    "cifar": random_crop_flip,
+}
+
+
+def get_augmentation(name):
+    if callable(name):
+        return name
+    if name not in AUGMENTATIONS:
+        raise KeyError(f"unknown augmentation {name!r}; known: {list(AUGMENTATIONS)}")
+    return AUGMENTATIONS[name]

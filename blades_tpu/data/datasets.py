@@ -1,0 +1,270 @@
+"""Dataset catalog: MNIST / FashionMNIST / CIFAR-10 + custom registration.
+
+Replaces the reference's torchvision-backed loaders and registry
+(ref: fllib/datasets/{mnist,fashionmnist,cifar10}.py, catalog.py).  This
+image has no torchvision and no network egress, so each built-in loads from
+a local cache of the standard raw files when present
+(``BLADES_TPU_DATA_ROOT``, default ``~/.blades_tpu/data``) and otherwise
+falls back to a *deterministic synthetic* dataset with the real shapes and
+label structure — clearly marked via ``FLDataset.synthetic`` — which keeps
+every test and benchmark runnable hermetically.
+
+Normalisation happens here (host, once); CIFAR train-time augmentation
+(random crop + flip, ref: fllib/datasets/cifar10.py:56-64) is the pure jax
+function :func:`blades_tpu.data.augment.random_crop_flip`, applied inside
+the train step (``TaskSpec(augment="cifar")``), because under jit
+augmentation must be keyed, not stateful.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import gzip
+import os
+import pickle
+import zlib
+from pathlib import Path
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from blades_tpu.data.partition import Partition, partition_dataset
+
+
+def data_root() -> Path:
+    return Path(os.environ.get("BLADES_TPU_DATA_ROOT", "~/.blades_tpu/data")).expanduser()
+
+
+@dataclasses.dataclass
+class FLDataset:
+    """A federated dataset: partitioned train shards + shared test set.
+
+    TPU-native analogue of the reference ``FLDataset``
+    (ref: fllib/datasets/fldataset.py:34-324): instead of per-client torch
+    Subsets + DataLoaders it holds one padded train :class:`Partition` and
+    the global test arrays; per-client test shards are a second Partition
+    (the reference evaluates per-client on client test splits,
+    ref: fldataset.py:323-324).
+    """
+
+    name: str
+    train: Partition
+    test_x: np.ndarray
+    test_y: np.ndarray
+    test: Optional[Partition]
+    num_classes: int
+    input_shape: Tuple[int, ...]
+    synthetic: bool = False
+
+    @property
+    def num_clients(self) -> int:
+        return self.train.num_clients
+
+
+# ---------------------------------------------------------------------------
+# Raw-file readers (standard formats, no torchvision)
+# ---------------------------------------------------------------------------
+
+
+def _read_idx(path: Path) -> np.ndarray:
+    """Parse an (optionally gzipped) IDX file (MNIST's native format)."""
+    opener = gzip.open if path.suffix == ".gz" else open
+    with opener(path, "rb") as f:
+        data = f.read()
+    magic = int.from_bytes(data[0:4], "big")
+    ndim = magic & 0xFF
+    dims = [int.from_bytes(data[4 + 4 * i : 8 + 4 * i], "big") for i in range(ndim)]
+    return np.frombuffer(data, np.uint8, offset=4 + 4 * ndim).reshape(dims)
+
+
+def _find(root: Path, names) -> Optional[Path]:
+    for n in names:
+        for cand in (root / n, root / (n + ".gz")):
+            if cand.exists():
+                return cand
+    return None
+
+
+def _load_mnist_like(subdir: str) -> Optional[Tuple[np.ndarray, ...]]:
+    root = data_root() / subdir
+    paths = [
+        _find(root, ["train-images-idx3-ubyte"]),
+        _find(root, ["train-labels-idx1-ubyte"]),
+        _find(root, ["t10k-images-idx3-ubyte"]),
+        _find(root, ["t10k-labels-idx1-ubyte"]),
+    ]
+    if any(p is None for p in paths):
+        return None
+    tx, ty, vx, vy = (_read_idx(p) for p in paths)
+    return tx, ty.astype(np.int32), vx, vy.astype(np.int32)
+
+
+def _load_cifar10() -> Optional[Tuple[np.ndarray, ...]]:
+    root = data_root() / "cifar10" / "cifar-10-batches-py"
+    if not root.exists():
+        root = data_root() / "cifar-10-batches-py"
+    if not root.exists():
+        return None
+
+    def read_batch(p: Path):
+        with open(p, "rb") as f:
+            d = pickle.load(f, encoding="bytes")
+        x = d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        return x, np.array(d[b"labels"], np.int32)
+
+    train = [read_batch(root / f"data_batch_{i}") for i in range(1, 6)]
+    tx = np.concatenate([b[0] for b in train])
+    ty = np.concatenate([b[1] for b in train])
+    vx, vy = read_batch(root / "test_batch")
+    return tx, ty, vx, vy
+
+
+def _synthetic_classification(
+    n_train: int,
+    n_test: int,
+    input_shape: Tuple[int, ...],
+    num_classes: int,
+    seed: int,
+) -> Tuple[np.ndarray, ...]:
+    """Deterministic learnable synthetic data: class-dependent means + noise.
+
+    Each class c gets a fixed random direction mu_c; samples are
+    ``mu_c + 0.5 * noise`` so simple models reach high accuracy quickly —
+    which is what integration tests need (the reference's SimpleDataset
+    plays the same role, ref: blades/algorithms/fedavg/tests/test_fedavg.py:26-55).
+    """
+    rng = np.random.default_rng(seed)
+    mus = rng.normal(0.0, 1.0, size=(num_classes,) + input_shape).astype(np.float32)
+
+    def make(n):
+        y = rng.integers(0, num_classes, size=n).astype(np.int32)
+        x = mus[y] + 0.5 * rng.normal(0.0, 1.0, size=(n,) + input_shape).astype(np.float32)
+        return x.astype(np.float32), y
+
+    tx, ty = make(n_train)
+    vx, vy = make(n_test)
+    return tx, ty, vx, vy
+
+
+# ---------------------------------------------------------------------------
+# Built-in dataset builders
+# ---------------------------------------------------------------------------
+
+MNIST_MEAN, MNIST_STD = 0.1307, 0.3081
+FMNIST_MEAN, FMNIST_STD = 0.286, 0.353
+CIFAR_MEAN = np.array([0.4914, 0.4822, 0.4465], np.float32)
+CIFAR_STD = np.array([0.2023, 0.1994, 0.2010], np.float32)
+
+
+def _norm_gray(x: np.ndarray, mean: float, std: float) -> np.ndarray:
+    return ((x.astype(np.float32) / 255.0) - mean) / std
+
+
+def _build_image_dataset(
+    name: str,
+    loader: Callable[[], Optional[Tuple[np.ndarray, ...]]],
+    normalize: Callable[[np.ndarray], np.ndarray],
+    input_shape: Tuple[int, ...],
+    num_classes: int,
+    num_clients: int,
+    iid: bool,
+    alpha: float,
+    seed: int,
+    train_frac: float,
+    synth_train: int,
+    synth_test: int,
+) -> FLDataset:
+    raw = loader()
+    synthetic = raw is None
+    if synthetic:
+        # Process-stable, caller-seed-dependent (str hash is randomized).
+        synth_seed = (zlib.crc32(name.encode()) ^ (seed * 0x9E3779B1)) % (2**31)
+        tx, ty, vx, vy = _synthetic_classification(
+            synth_train, synth_test, input_shape, num_classes, seed=synth_seed
+        )
+    else:
+        tx, ty, vx, vy = raw
+        tx, vx = normalize(tx), normalize(vx)
+        if tx.shape[1:] != input_shape:
+            tx = tx.reshape((-1,) + input_shape)
+            vx = vx.reshape((-1,) + input_shape)
+    del train_frac  # reference's train_data_frac subsetting: not used by tuned configs
+    train = partition_dataset(tx, ty, num_clients, iid=iid, alpha=alpha, seed=seed)
+    test = partition_dataset(vx, vy, num_clients, iid=True, seed=seed + 1)
+    return FLDataset(
+        name=name,
+        train=train,
+        test_x=vx,
+        test_y=vy,
+        test=test,
+        num_classes=num_classes,
+        input_shape=input_shape,
+        synthetic=synthetic,
+    )
+
+
+def build_mnist(num_clients=60, iid=True, alpha=0.1, seed=0, **kw) -> FLDataset:
+    return _build_image_dataset(
+        "mnist", _load_mnist_like_factory("mnist"),
+        lambda x: _norm_gray(x, MNIST_MEAN, MNIST_STD)[..., None],
+        (28, 28, 1), 10, num_clients, iid, alpha, seed,
+        kw.get("train_frac", 1.0), 6000, 1000,
+    )
+
+
+def build_fashionmnist(num_clients=60, iid=True, alpha=0.1, seed=0, **kw) -> FLDataset:
+    return _build_image_dataset(
+        "fashionmnist", _load_mnist_like_factory("fashionmnist"),
+        lambda x: _norm_gray(x, FMNIST_MEAN, FMNIST_STD)[..., None],
+        (28, 28, 1), 10, num_clients, iid, alpha, seed,
+        kw.get("train_frac", 1.0), 6000, 1000,
+    )
+
+
+def build_cifar10(num_clients=60, iid=True, alpha=0.1, seed=0, **kw) -> FLDataset:
+    def norm(x):
+        return ((x.astype(np.float32) / 255.0) - CIFAR_MEAN) / CIFAR_STD
+
+    return _build_image_dataset(
+        "cifar10", _load_cifar10, norm,
+        (32, 32, 3), 10, num_clients, iid, alpha, seed,
+        kw.get("train_frac", 1.0), 5000, 1000,
+    )
+
+
+def _load_mnist_like_factory(subdir: str):
+    return lambda: _load_mnist_like(subdir)
+
+
+# ---------------------------------------------------------------------------
+# Catalog (ref: fllib/datasets/catalog.py)
+# ---------------------------------------------------------------------------
+
+_REGISTRY: Dict[str, Callable[..., FLDataset]] = {
+    "mnist": build_mnist,
+    "fashionmnist": build_fashionmnist,
+    "cifar10": build_cifar10,
+}
+
+
+def register_dataset(name: str, builder: Callable[..., FLDataset]) -> None:
+    """Register a custom dataset builder
+    (ref: fllib/datasets/catalog.py:90-100)."""
+    _REGISTRY[name.lower()] = builder
+
+
+class DatasetCatalog:
+    """String → :class:`FLDataset` resolution (ref: catalog.py:46-88)."""
+
+    @staticmethod
+    def get_dataset(spec, **overrides) -> FLDataset:
+        if isinstance(spec, FLDataset):
+            return spec
+        if isinstance(spec, str):
+            spec = {"type": spec}
+        cfg = {**dict(spec), **overrides}
+        name = cfg.pop("type").lower()
+        if name not in _REGISTRY:
+            raise KeyError(f"unknown dataset {name!r}; known: {sorted(_REGISTRY)}")
+        cfg.pop("custom_dataset_config", None)
+        return _REGISTRY[name](**cfg)

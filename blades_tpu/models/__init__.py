@@ -1,0 +1,24 @@
+"""Model zoo (ref: fllib/models/): MLP, FashionCNN, CIFAR ResNets, CCT.
+
+All models are flax.linen modules that are *pure functions of params* — the
+CIFAR ResNets use batch-statistics-only normalisation, matching the
+reference's ``track_running_stats=False`` BatchNorm
+(ref: fllib/models/cifar10/resnet_cifar.py:14,18), which is the property
+that makes FL weight averaging sound (no running stats to desynchronise)
+and makes ``vmap`` over per-client params trivial (no mutable collections).
+
+Input convention is NHWC (TPU-native layout), unlike the reference's NCHW.
+"""
+
+from blades_tpu.models.catalog import ModelCatalog, register_model  # noqa: F401
+from blades_tpu.models.mlp import MLP  # noqa: F401
+from blades_tpu.models.cnn import FashionCNN  # noqa: F401
+from blades_tpu.models.resnet import (  # noqa: F401
+    ResNet10,
+    ResNet18,
+    ResNet34,
+    ResNet50,
+    ResNet101,
+    ResNet152,
+)
+from blades_tpu.models.cct import CCT, cct_2_3x2_32, cct_4_3x2_32, cct_7_3x1_32  # noqa: F401

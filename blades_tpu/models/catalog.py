@@ -1,0 +1,65 @@
+"""Model catalog (ref: fllib/models/catalog.py:16-47).
+
+Resolves a model spec — substring-matched name ("cct"/"resnet"/"mlp"/"cnn",
+same matching rule as the reference), a flax Module instance, or a custom
+registered name — to a linen module.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import flax.linen as nn
+
+from blades_tpu.models.cct import cct_2_3x2_32
+from blades_tpu.models.cnn import FashionCNN
+from blades_tpu.models.mlp import MLP
+from blades_tpu.models.resnet import (
+    ResNet10,
+    ResNet18,
+    ResNet34,
+    ResNet50,
+    ResNet101,
+    ResNet152,
+)
+
+_CUSTOM: Dict[str, Callable[..., nn.Module]] = {}
+
+_RESNETS = {
+    "resnet10": ResNet10,
+    "resnet18": ResNet18,
+    "resnet34": ResNet34,
+    "resnet50": ResNet50,
+    "resnet101": ResNet101,
+    "resnet152": ResNet152,
+}
+
+
+def register_model(name: str, builder: Callable[..., nn.Module]) -> None:
+    """Register a custom model builder (ref: catalog.py:37-47)."""
+    _CUSTOM[name.lower()] = builder
+
+
+class ModelCatalog:
+    @staticmethod
+    def get_model(spec, num_classes: int = 10) -> nn.Module:
+        if isinstance(spec, nn.Module):
+            return spec
+        if callable(spec) and not isinstance(spec, str):
+            return spec()
+        name = str(spec).lower()
+        if name in _CUSTOM:
+            return _CUSTOM[name](num_classes=num_classes)
+        if name in _RESNETS:
+            return _RESNETS[name](num_classes=num_classes)
+        # Substring matching, same precedence as the reference
+        # (ref: fllib/models/catalog.py:16-29): "resnet" -> ResNet10.
+        if "cct" in name:
+            return cct_2_3x2_32(num_classes=num_classes)
+        if "resnet" in name:
+            return ResNet10(num_classes=num_classes)
+        if "mlp" in name:
+            return MLP(num_classes=num_classes)
+        if "cnn" in name:
+            return FashionCNN(num_classes=num_classes)
+        raise KeyError(f"unknown model {spec!r}")

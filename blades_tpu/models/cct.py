@@ -1,0 +1,161 @@
+"""Compact Convolutional Transformer (ref: fllib/models/backbones/cctnets/).
+
+A from-scratch flax implementation of CCT (Hassani et al., "Escaping the
+Big Data Paradigm with Compact Transformers"): convolutional tokenizer →
+transformer encoder with stochastic depth → sequence (attention) pooling.
+The reference vendors the authors' torch zoo (cct.py:655); the catalog uses
+``cct_2_3x2_32`` (ref: fllib/models/catalog.py:18-19), i.e. 2 encoder
+layers, 3x3 conv tokenizer, 2 conv layers, 32x32 input.  Supports learnable
+or sinusoidal positional embeddings, matching the vendored options.
+
+Attention/MLP widths are MXU-friendly multiples; everything is static-shape
+so XLA tiles cleanly.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def sinusoidal_embedding(num_pos: int, dim: int) -> jnp.ndarray:
+    pos = jnp.arange(num_pos)[:, None].astype(jnp.float32)
+    i = jnp.arange(dim)[None, :].astype(jnp.float32)
+    angle = pos / jnp.power(10000.0, (2 * (i // 2)) / dim)
+    emb = jnp.where(i % 2 == 0, jnp.sin(angle), jnp.cos(angle))
+    return emb[None]  # (1, num_pos, dim)
+
+
+class Tokenizer(nn.Module):
+    """Conv tokenizer: n_conv_layers of (conv k×k → relu → 3x3/2 maxpool)."""
+
+    embed_dim: int
+    kernel_size: int = 3
+    n_conv_layers: int = 2
+
+    @nn.compact
+    def __call__(self, x):
+        features = [self.embed_dim // (2 ** (self.n_conv_layers - 1 - i))
+                    for i in range(self.n_conv_layers)]
+        for f in features:
+            x = nn.Conv(f, (self.kernel_size, self.kernel_size),
+                        padding=self.kernel_size // 2, use_bias=False)(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        return x.reshape((x.shape[0], -1, x.shape[-1]))  # (B, seq, dim)
+
+
+class StochasticDepth(nn.Module):
+    """Per-sample residual drop (ref: cctnets stochastic_depth)."""
+
+    rate: float
+
+    @nn.compact
+    def __call__(self, x, *, train: bool):
+        if not train or self.rate == 0.0:
+            return x
+        keep = 1.0 - self.rate
+        rng = self.make_rng("dropout")
+        mask_shape = (x.shape[0],) + (1,) * (x.ndim - 1)
+        mask = jax.random.bernoulli(rng, keep, mask_shape)
+        return jnp.where(mask, x / keep, 0.0)
+
+
+class EncoderBlock(nn.Module):
+    dim: int
+    num_heads: int
+    mlp_ratio: float = 1.0
+    attn_dropout: float = 0.1
+    dropout: float = 0.1
+    drop_path: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        y = nn.LayerNorm()(x)
+        y = nn.MultiHeadDotProductAttention(
+            num_heads=self.num_heads,
+            dropout_rate=self.attn_dropout,
+            deterministic=not train,
+        )(y, y)
+        y = nn.Dropout(self.dropout, deterministic=not train)(y)
+        x = x + StochasticDepth(self.drop_path)(y, train=train)
+        y = nn.LayerNorm()(x)
+        y = nn.Dense(int(self.dim * self.mlp_ratio))(y)
+        y = nn.gelu(y)
+        y = nn.Dropout(self.dropout, deterministic=not train)(y)
+        y = nn.Dense(self.dim)(y)
+        y = nn.Dropout(self.dropout, deterministic=not train)(y)
+        return x + StochasticDepth(self.drop_path)(y, train=train)
+
+
+class CCT(nn.Module):
+    num_classes: int = 10
+    embed_dim: int = 128
+    num_layers: int = 2
+    num_heads: int = 2
+    mlp_ratio: float = 1.0
+    kernel_size: int = 3
+    n_conv_layers: int = 2
+    positional_embedding: str = "learnable"  # learnable | sine | none
+    dropout: float = 0.0
+    attn_dropout: float = 0.1
+    stochastic_depth: float = 0.1
+    img_size: int = 32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        x = Tokenizer(self.embed_dim, self.kernel_size, self.n_conv_layers)(x)
+        seq_len = x.shape[1]
+        if self.positional_embedding == "learnable":
+            pe = self.param(
+                "pos_embed",
+                nn.initializers.truncated_normal(0.2),
+                (1, seq_len, self.embed_dim),
+            )
+            x = x + pe
+        elif self.positional_embedding == "sine":
+            x = x + sinusoidal_embedding(seq_len, self.embed_dim)
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        dpr = [
+            self.stochastic_depth * i / max(self.num_layers - 1, 1)
+            for i in range(self.num_layers)
+        ]
+        for i in range(self.num_layers):
+            x = EncoderBlock(
+                self.embed_dim, self.num_heads, self.mlp_ratio,
+                self.attn_dropout, self.dropout, dpr[i],
+            )(x, train=train)
+        x = nn.LayerNorm()(x)
+        # Sequence pooling: softmax attention over tokens (CCT's SeqPool).
+        attn = nn.Dense(1)(x)  # (B, seq, 1)
+        attn = jax.nn.softmax(attn, axis=1)
+        x = jnp.einsum("bs1,bsd->bd", attn, x)
+        return nn.Dense(self.num_classes)(x)
+
+
+def cct_2_3x2_32(num_classes: int = 10, positional_embedding: str = "learnable") -> CCT:
+    """CCT-2/3x2 for 32x32 (the catalog default, ref: fllib/models/catalog.py:18)."""
+    return CCT(
+        num_classes=num_classes, embed_dim=128, num_layers=2, num_heads=2,
+        mlp_ratio=1.0, kernel_size=3, n_conv_layers=2,
+        positional_embedding=positional_embedding,
+    )
+
+
+def cct_4_3x2_32(num_classes: int = 10, positional_embedding: str = "learnable") -> CCT:
+    return CCT(
+        num_classes=num_classes, embed_dim=128, num_layers=4, num_heads=2,
+        mlp_ratio=1.0, kernel_size=3, n_conv_layers=2,
+        positional_embedding=positional_embedding,
+    )
+
+
+def cct_7_3x1_32(num_classes: int = 10, positional_embedding: str = "learnable") -> CCT:
+    return CCT(
+        num_classes=num_classes, embed_dim=256, num_layers=7, num_heads=4,
+        mlp_ratio=2.0, kernel_size=3, n_conv_layers=1,
+        positional_embedding=positional_embedding,
+    )
+
+

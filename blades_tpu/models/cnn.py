@@ -1,0 +1,30 @@
+"""Fashion-MNIST CNN (ref: fllib/models/fashionmnist/cnn.py:5-38).
+
+Faithful capability port: conv(3x3, pad 1) -> relu -> maxpool, then
+conv(3x3, VALID) -> relu -> maxpool (14 -> 12 -> 6 spatial, so fc1 sees
+64*6*6 features), then fc 600 -> dropout(0.25) -> fc 120 -> fc 10 with no
+intermediate nonlinearities — the reference's BatchNorms are commented out
+and its dense stack is linear (ref: cnn.py:11-21, 29-38).  NHWC layout.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+
+
+class FashionCNN(nn.Module):
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        x = nn.Conv(32, (3, 3), padding=1)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (3, 3), padding="VALID")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(600)(x)
+        x = nn.Dropout(0.25, deterministic=not train)(x)
+        x = nn.Dense(120)(x)
+        return nn.Dense(self.num_classes)(x)

@@ -1,0 +1,37 @@
+"""Shared layers: stateless batch normalisation.
+
+The reference pins ``track_running_stats=False`` on every BatchNorm
+(ref: fllib/models/cifar10/resnet_cifar.py:10-18) so that federated weight
+averaging never mixes desynchronised running statistics.  In JAX that
+semantics is *simpler* than the stateful default: normalise by the current
+batch's statistics, carry no state at all.  This keeps model application a
+pure function ``(params, x) -> logits`` — no mutable collections, which is
+what lets per-client models be a stacked-params ``vmap``.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class BatchStatsNorm(nn.Module):
+    """Batch-statistics-only normalisation with learned scale/bias."""
+
+    epsilon: float = 1e-5
+    use_scale: bool = True
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        axes = tuple(range(x.ndim - 1))
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.var(x, axis=axes)
+        y = (x - mean) * jax.lax.rsqrt(var + self.epsilon)
+        features = x.shape[-1]
+        if self.use_scale:
+            y = y * self.param("scale", nn.initializers.ones, (features,))
+        if self.use_bias:
+            y = y + self.param("bias", nn.initializers.zeros, (features,))
+        return y

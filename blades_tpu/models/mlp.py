@@ -1,0 +1,22 @@
+"""MNIST MLP (ref: fllib/models/mnist/mlp.py:5-35): 784-128-256-10,
+dropout 0.2 between hidden layers."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+
+
+class MLP(nn.Module):
+    hidden1: int = 128
+    hidden2: int = 256
+    num_classes: int = 10
+    dropout_rate: float = 0.2
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(self.hidden1)(x))
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(self.hidden2)(x))
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        return nn.Dense(self.num_classes)(x)

@@ -1,0 +1,102 @@
+"""CIFAR ResNet family (ref: fllib/models/cifar10/resnet_cifar.py).
+
+ResNet-10/18/34 use BasicBlock, 50/101/152 use Bottleneck; the stem is the
+CIFAR variant (3x3 conv, no max-pool).  All normalisation is
+:class:`BatchStatsNorm` — the reference's ``track_running_stats=False``
+BatchNorm (ref: resnet_cifar.py:14,18,85) — so models are pure functions of
+params.  NHWC layout, bfloat16-friendly (params stay f32; cast activations
+outside if desired).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Type
+
+import flax.linen as nn
+
+from blades_tpu.models.layers import BatchStatsNorm
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    stride: int = 1
+    expansion: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = nn.Conv(self.filters, (3, 3), strides=self.stride, padding=1, use_bias=False)(x)
+        y = nn.relu(BatchStatsNorm()(y))
+        y = nn.Conv(self.filters, (3, 3), padding=1, use_bias=False)(y)
+        y = BatchStatsNorm()(y)
+        if self.stride != 1 or x.shape[-1] != self.filters * self.expansion:
+            residual = nn.Conv(
+                self.filters * self.expansion, (1, 1), strides=self.stride, use_bias=False
+            )(x)
+            residual = BatchStatsNorm()(residual)
+        return nn.relu(y + residual)
+
+
+class Bottleneck(nn.Module):
+    filters: int
+    stride: int = 1
+    expansion: int = 4
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = nn.Conv(self.filters, (1, 1), use_bias=False)(x)
+        y = nn.relu(BatchStatsNorm()(y))
+        y = nn.Conv(self.filters, (3, 3), strides=self.stride, padding=1, use_bias=False)(y)
+        y = nn.relu(BatchStatsNorm()(y))
+        y = nn.Conv(self.filters * self.expansion, (1, 1), use_bias=False)(y)
+        y = BatchStatsNorm()(y)
+        if self.stride != 1 or x.shape[-1] != self.filters * self.expansion:
+            residual = nn.Conv(
+                self.filters * self.expansion, (1, 1), strides=self.stride, use_bias=False
+            )(x)
+            residual = BatchStatsNorm()(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    block: Type[nn.Module]
+    stage_sizes: Sequence[int]
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        del train  # no dropout / no mutable norm state
+        x = nn.Conv(64, (3, 3), padding=1, use_bias=False)(x)
+        x = nn.relu(BatchStatsNorm()(x))
+        for i, num_blocks in enumerate(self.stage_sizes):
+            filters = 64 * 2**i
+            for j in range(num_blocks):
+                stride = 2 if i > 0 and j == 0 else 1
+                x = self.block(filters, stride)(x)
+        x = x.mean(axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+def ResNet10(num_classes: int = 10) -> ResNet:
+    return ResNet(BasicBlock, (1, 1, 1, 1), num_classes)
+
+
+def ResNet18(num_classes: int = 10) -> ResNet:
+    return ResNet(BasicBlock, (2, 2, 2, 2), num_classes)
+
+
+def ResNet34(num_classes: int = 10) -> ResNet:
+    return ResNet(BasicBlock, (3, 4, 6, 3), num_classes)
+
+
+def ResNet50(num_classes: int = 10) -> ResNet:
+    return ResNet(Bottleneck, (3, 4, 6, 3), num_classes)
+
+
+def ResNet101(num_classes: int = 10) -> ResNet:
+    return ResNet(Bottleneck, (3, 4, 23, 3), num_classes)
+
+
+def ResNet152(num_classes: int = 10) -> ResNet:
+    return ResNet(Bottleneck, (3, 8, 36, 3), num_classes)

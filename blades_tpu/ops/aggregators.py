@@ -175,6 +175,13 @@ class DnC(Aggregator):
         n, d = updates.shape
         sub_dim = min(self.sub_dim, d)
         keep = n - int(self.filter_frac * self.num_byzantine)
+        if keep < 1:
+            raise ValueError(
+                f"DnC keeps n - filter_frac*num_byzantine = {keep} clients; "
+                f"needs >= 1 (n={n}, f={self.num_byzantine}, "
+                f"filter_frac={self.filter_frac}) — an empty keep-set would "
+                "silently degrade to the unfiltered mean"
+            )
 
         def one_iter(k):
             idx = jax.random.permutation(k, d)[:sub_dim]
@@ -372,12 +379,17 @@ class FLTrust(Aggregator):
     """FLTrust (Cao et al., arXiv:2012.13995) — trust-bootstrapped mean.
 
     Not in the reference aggregator suite but named by its benchmark targets
-    (BASELINE.json "DnC/FLTrust"); included for completeness.  Requires a
-    trusted server update as the last row of ``updates`` by convention when
-    ``server_update`` is not supplied via functools.partial-style wrapping.
-    Trust score of client i = ReLU(cos(u_i, u_0)); each client update is
-    rescaled to the server update's norm and trust-weighted.
+    (BASELINE.json "DnC/FLTrust"); included for completeness.  Requires the
+    trusted server update (computed on server-held root data) as the LAST
+    row of ``updates``; callers must append it explicitly —
+    ``blades_tpu.core.Server.step`` does so via its ``trusted_update``
+    argument and refuses to run FLTrust without one (a client row standing
+    in as the root of trust would invert the defense).
+    Trust score of client i = ReLU(cos(u_i, u_server)); each client update
+    is rescaled to the server update's norm and trust-weighted.
     """
+
+    expects_trusted_row: bool = True
 
     def aggregate(self, updates: jax.Array) -> jax.Array:
         # Last row is the trusted server update, preceding rows the clients.

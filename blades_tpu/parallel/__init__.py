@@ -1,0 +1,33 @@
+"""Parallel layer: mesh + sharding — the execution AND communication layer.
+
+Replaces two reference subsystems with one mechanism (SURVEY.md §2.8-2.9):
+
+- the Ray execution layer (actor pools, shard-affinity scheduling, weight
+  broadcast over the object store — ref: fllib/core/execution/) becomes a
+  ``clients`` mesh axis: client shards live on their device permanently
+  (affinity is the sharding), weight "sync" is XLA broadcasting a
+  replicated pytree, and the update "gather" is an ICI collective;
+- the experimental NCCL communicator (ref: fllib/communication/) is
+  likewise subsumed — there is no host-side messaging at all.
+
+Two interchangeable drivers of the same :class:`~blades_tpu.core.FedRound`
+program:
+
+- :func:`sharded_step` — GSPMD: jit with ``NamedSharding`` annotations;
+  XLA's partitioner inserts the collectives (the default, least code,
+  compiler-optimised overlap).
+- :func:`shard_map_step` — explicit ``shard_map``: per-device local rounds
+  + hand-placed ``all_gather`` of the update matrix, for when collective
+  placement must be controlled.
+
+Multi-host (DCN) attaches via :func:`init_distributed`.
+"""
+
+from blades_tpu.parallel.mesh import (  # noqa: F401
+    client_axis_sharding,
+    init_distributed,
+    make_mesh,
+    replicated_sharding,
+    shard_federation,
+)
+from blades_tpu.parallel.sharded import shard_map_step, sharded_step  # noqa: F401

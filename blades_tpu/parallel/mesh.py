@@ -1,0 +1,99 @@
+"""Mesh construction + sharding placement for the federation.
+
+The canonical layout: a 1-D mesh over the ``clients`` axis.  Client-stacked
+pytrees (data shards, per-client optimizer state, malicious mask) shard
+along their leading axis; server state (params, opt state, aggregator
+state) is replicated.  This is the static, compiler-visible version of the
+reference's client→actor affinity map (ref: fllib/core/execution/
+actor_manager.py:8-21) — data never moves between devices after setup.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+CLIENTS_AXIS = "clients"
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Multi-host (DCN) initialisation via ``jax.distributed``.
+
+    The TPU-native replacement for the reference's
+    ``dist.init_process_group(backend="nccl")`` with its hardcoded master
+    address (ref: fllib/communication/communicator.py:148-184): on TPU pods
+    the coordinator is discovered from the environment, or passed
+    explicitly for manual bring-up.  No-op when already initialised or when
+    running single-process.
+
+    Must run before any other jax call — ``jax.distributed.initialize``
+    requires an uninitialised backend, so this function must NOT probe
+    ``jax.process_count()``/``jax.devices()`` first.
+    """
+    from jax._src import distributed as _dist
+
+    if _dist.global_state.client is not None:
+        return  # already initialised
+    kwargs = {}
+    if coordinator_address:
+        kwargs = dict(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    elif not os.environ.get("JAX_COORDINATOR_ADDRESS") and num_processes is None:
+        return  # single-process run; nothing to do
+    jax.distributed.initialize(**kwargs)
+
+
+def make_mesh(
+    num_devices: Optional[int] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+    axis_name: str = CLIENTS_AXIS,
+) -> Mesh:
+    """A 1-D device mesh over the client axis."""
+    if devices is None:
+        devices = jax.devices()
+    if num_devices is not None:
+        if num_devices > len(devices):
+            raise ValueError(
+                f"requested {num_devices} devices, have {len(devices)}"
+            )
+        devices = devices[:num_devices]
+    return Mesh(np.asarray(devices), (axis_name,))
+
+
+def client_axis_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (client) axis over the mesh."""
+    return NamedSharding(mesh, P(CLIENTS_AXIS))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_federation(mesh: Mesh, round_state, data_arrays: Sequence[Any]):
+    """Place a :class:`~blades_tpu.core.RoundState` + client data onto the mesh.
+
+    Server state replicates; everything client-stacked shards on its leading
+    axis.  Client counts must divide the mesh size (pad the federation to a
+    multiple of the device count — the analogue of the reference requiring
+    ``num_clients`` divisible over workers).
+    """
+    cs = client_axis_sharding(mesh)
+    rep = replicated_sharding(mesh)
+    import dataclasses as _dc
+
+    server = jax.device_put(round_state.server, rep)
+    client_opt = jax.tree.map(lambda a: jax.device_put(a, cs), round_state.client_opt)
+    state = _dc.replace(round_state, server=server, client_opt=client_opt)
+    data = tuple(jax.device_put(a, cs) for a in data_arrays)
+    return state, data

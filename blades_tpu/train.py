@@ -1,0 +1,71 @@
+"""CLI (ref: blades/train.py): ``python -m blades_tpu.train file <yaml>`` /
+``run <ALGO>`` — argparse instead of Typer (not in this image), same
+command surface: experiment files with grid_search, or a one-off run with
+inline overrides."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="blades_tpu.train",
+        description="TPU-native Byzantine-robust FL training "
+        "(ref CLI surface: blades/train.py:129-307)",
+    )
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_file = sub.add_parser("file", help="run experiments from a YAML grid file")
+    p_file.add_argument("experiment_file")
+    p_file.add_argument("--storage-path", default="~/blades_tpu_results")
+    p_file.add_argument("--checkpoint-freq", type=int, default=0)
+    p_file.add_argument("--checkpoint-at-end", action="store_true")
+    p_file.add_argument("--max-rounds", type=int, default=None,
+                        help="override every experiment's training_iteration")
+    p_file.add_argument("-v", "--verbose", action="count", default=1)
+
+    p_run = sub.add_parser("run", help="run one algorithm with overrides")
+    p_run.add_argument("algo", help="FEDAVG or FEDAVG_DP")
+    p_run.add_argument("--config-json", default="{}",
+                       help='flat/nested config overrides as JSON, e.g. '
+                       '\'{"dataset_config": {"type": "mnist"}}\'')
+    p_run.add_argument("--rounds", type=int, default=100)
+    p_run.add_argument("--storage-path", default="~/blades_tpu_results")
+    p_run.add_argument("-v", "--verbose", action="count", default=1)
+
+    args = parser.parse_args(argv)
+
+    from blades_tpu.tune import load_experiments_from_file, run_experiments
+
+    if args.cmd == "file":
+        experiments = load_experiments_from_file(args.experiment_file)
+        summaries = run_experiments(
+            experiments,
+            storage_path=args.storage_path,
+            verbose=args.verbose,
+            checkpoint_freq=args.checkpoint_freq,
+            checkpoint_at_end=args.checkpoint_at_end,
+            max_rounds_override=args.max_rounds,
+        )
+    else:
+        experiments = {
+            f"{args.algo.lower()}_run": {
+                "run": args.algo,
+                "stop": {"training_iteration": args.rounds},
+                "config": json.loads(args.config_json),
+            }
+        }
+        summaries = run_experiments(
+            experiments, storage_path=args.storage_path, verbose=args.verbose
+        )
+    best = max(summaries, key=lambda s: s["best_test_acc"], default=None)
+    if best:
+        print(f"best trial: {best['trial']} test_acc={best['best_test_acc']:.4f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

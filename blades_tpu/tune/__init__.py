@@ -1,0 +1,17 @@
+"""Experiment sweeps: YAML grids → trials → results
+(ref: blades/train.py + blades/tuned_examples/).
+
+``grid_search`` nodes at arbitrary depth expand to the cartesian trial
+product exactly like Ray Tune's; trials run sequentially on the chip (the
+reference's experiment-parallelism across a Ray cluster becomes
+chip-sequential sweeps — or one sweep per host over DCN).  Results stream
+to ``result.json`` lines per trial, the format the reference's
+visualization reads (ref: blades/tuned_examples/visualization/
+visualize.py:14-35).
+"""
+
+from blades_tpu.tune.sweep import (  # noqa: F401
+    expand_grid,
+    load_experiments_from_file,
+    run_experiments,
+)

@@ -1,0 +1,153 @@
+"""Grid expansion + sequential trial runner (ref: blades/train.py:60-126,
+310-408)."""
+
+from __future__ import annotations
+
+import copy
+import itertools
+import json
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple
+
+import yaml
+
+
+# ---------------------------------------------------------------------------
+# grid_search expansion (Tune-compatible)
+# ---------------------------------------------------------------------------
+
+
+def _find_grids(node: Any, path: Tuple = ()) -> List[Tuple[Tuple, List]]:
+    """Locate every ``{"grid_search": [...]}`` node (depth-first)."""
+    grids = []
+    if isinstance(node, dict):
+        if set(node.keys()) == {"grid_search"}:
+            return [(path, node["grid_search"])]
+        for k, v in node.items():
+            grids.extend(_find_grids(v, path + (k,)))
+    elif isinstance(node, list):
+        for i, v in enumerate(node):
+            grids.extend(_find_grids(v, path + (i,)))
+    return grids
+
+
+def _set_path(cfg: Any, path: Tuple, value: Any) -> None:
+    node = cfg
+    for p in path[:-1]:
+        node = node[p]
+    node[path[-1]] = value
+
+
+def expand_grid(config: Dict) -> List[Dict]:
+    """Cartesian product over every grid_search node; deterministic order."""
+    grids = _find_grids(config)
+    if not grids:
+        return [copy.deepcopy(config)]
+    paths = [g[0] for g in grids]
+    values = [g[1] for g in grids]
+    trials = []
+    for combo in itertools.product(*values):
+        trial = copy.deepcopy(config)
+        for path, v in zip(paths, combo):
+            _set_path(trial, path, copy.deepcopy(v))
+        trials.append(trial)
+    return trials
+
+
+# ---------------------------------------------------------------------------
+# experiment loading (ref: train.py:60-126)
+# ---------------------------------------------------------------------------
+
+
+def load_experiments_from_file(path: str) -> Dict[str, Dict]:
+    """YAML file of ``{name: {run, stop, config, ...}}`` experiment specs."""
+    with open(path) as f:
+        experiments = yaml.safe_load(f)
+    if not isinstance(experiments, dict):
+        raise ValueError(f"{path} must map experiment names to specs")
+    for name, spec in experiments.items():
+        if "run" not in spec:
+            raise ValueError(f"experiment {name!r} missing 'run' (algorithm name)")
+        spec.setdefault("stop", {"training_iteration": 100})
+        spec.setdefault("config", {})
+    return experiments
+
+
+# ---------------------------------------------------------------------------
+# trial runner (ref: train.py:310-408 without the Ray cluster)
+# ---------------------------------------------------------------------------
+
+
+def _trial_name(base: str, idx: int, trial_cfg: Dict) -> str:
+    return f"{base}_{idx:05d}"
+
+
+def run_experiments(
+    experiments: Dict[str, Dict],
+    storage_path: str = "~/blades_tpu_results",
+    verbose: int = 1,
+    checkpoint_freq: int = 0,
+    checkpoint_at_end: bool = False,
+    max_rounds_override: Optional[int] = None,
+) -> List[Dict]:
+    """Run every trial of every experiment sequentially; returns summaries.
+
+    Per trial: ``result.json`` (one JSON line per round, Tune's format) and
+    ``params.json`` in ``<storage>/<experiment>/<trial>/``.
+    """
+    from blades_tpu.algorithms import get_algorithm_class
+
+    root = Path(storage_path).expanduser()
+    summaries = []
+    for exp_name, spec in experiments.items():
+        trials = expand_grid(spec.get("config", {}))
+        stop = spec.get("stop", {})
+        max_rounds = int(max_rounds_override or stop.get("training_iteration", 100))
+        for i, trial_cfg in enumerate(trials):
+            tname = _trial_name(exp_name, i, trial_cfg)
+            tdir = root / exp_name / tname
+            tdir.mkdir(parents=True, exist_ok=True)
+            algo_cls, config = get_algorithm_class(spec["run"], return_config=True)
+            config.update_from_dict(trial_cfg)
+            algo = config.build()
+            with open(tdir / "params.json", "w") as f:
+                json.dump(_jsonable(trial_cfg), f, indent=2, default=str)
+            if verbose:
+                print(f"== trial {tname}: {max_rounds} rounds ==", flush=True)
+            best_acc, t0 = 0.0, time.perf_counter()
+            with open(tdir / "result.json", "w") as f:
+                for _ in range(max_rounds):
+                    result = algo.train()
+                    result["trial"] = tname
+                    f.write(json.dumps(_jsonable(result)) + "\n")
+                    best_acc = max(best_acc, result.get("test_acc", 0.0))
+                    if checkpoint_freq and algo.iteration % checkpoint_freq == 0:
+                        algo.save_checkpoint(str(tdir / f"ckpt_{algo.iteration:06d}"))
+                    if verbose > 1 and algo.iteration % 10 == 0:
+                        print(f"  round {algo.iteration}: {result}", flush=True)
+            if checkpoint_at_end:
+                algo.save_checkpoint(str(tdir / "ckpt_final"))
+            wall = time.perf_counter() - t0
+            summary = {
+                "trial": tname, "rounds": max_rounds, "wall_s": round(wall, 2),
+                "rounds_per_sec": round(max_rounds / wall, 2),
+                "best_test_acc": best_acc, "final": algo._last_eval,
+                "dir": str(tdir),
+            }
+            if verbose:
+                print(f"   -> {summary}", flush=True)
+            summaries.append(summary)
+    return summaries
+
+
+def _jsonable(obj):
+    import numpy as np
+
+    if isinstance(obj, dict):
+        return {k: _jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(v) for v in obj]
+    if isinstance(obj, (np.floating, np.integer)):
+        return obj.item()
+    return obj

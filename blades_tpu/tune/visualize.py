@@ -1,0 +1,89 @@
+"""Result visualization (ref: blades/tuned_examples/visualization/
+visualize.py:8-49): read trial dirs (params.json + result.json), build a
+tidy DataFrame, and plot accuracy vs #malicious per aggregator as a
+seaborn FacetGrid."""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Optional
+
+import pandas as pd
+
+
+def load_results(storage_path: str) -> pd.DataFrame:
+    """Flatten every trial under ``storage_path`` into one tidy frame."""
+    rows = []
+    root = Path(storage_path).expanduser()
+    for result_file in sorted(root.glob("**/result.json")):
+        tdir = result_file.parent
+        params = {}
+        pfile = tdir / "params.json"
+        if pfile.exists():
+            params = json.loads(pfile.read_text())
+        agg = (params.get("server_config", {}) or {}).get("aggregator", {})
+        adv = params.get("adversary_config", {}) or {}
+        meta = {
+            "trial": tdir.name,
+            "experiment": tdir.parent.name,
+            "aggregator": agg.get("type", "Mean") if isinstance(agg, dict) else str(agg),
+            "adversary": adv.get("type", "None") if isinstance(adv, dict) else str(adv),
+            "num_malicious": params.get("num_malicious_clients", 0),
+            "alpha": (params.get("dataset_config", {}) or {}).get("alpha"),
+        }
+        for line in result_file.read_text().splitlines():
+            r = json.loads(line)
+            rows.append({**meta, **{k: v for k, v in r.items()
+                                    if not isinstance(v, dict)}})
+    return pd.DataFrame(rows)
+
+
+def plot_accuracy_grid(df: pd.DataFrame, out_path: Optional[str] = None):
+    """Accuracy vs #malicious, one facet per adversary, hue = aggregator
+    (the reference's headline figure, ref: visualize.py:36-49)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import seaborn as sns
+
+    final = (
+        df.dropna(subset=["test_acc"])
+        .sort_values("training_iteration")
+        .groupby(["aggregator", "adversary", "num_malicious", "trial"])
+        .tail(1)
+    )
+    g = sns.FacetGrid(final, col="adversary", col_wrap=3, height=3)
+    g.map_dataframe(sns.lineplot, x="num_malicious", y="test_acc",
+                    hue="aggregator", marker="o")
+    g.add_legend()
+    if out_path:
+        g.savefig(out_path, dpi=150)
+    return g
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(description="summarise / plot sweep results")
+    p.add_argument("storage_path")
+    p.add_argument("--plot", default=None, help="output PNG path")
+    args = p.parse_args(argv)
+    df = load_results(args.storage_path)
+    if df.empty:
+        print("no results found")
+        return 1
+    final = df.dropna(subset=["test_acc"]).groupby("trial").tail(1)
+    cols = [c for c in ("experiment", "trial", "aggregator", "adversary",
+                        "num_malicious", "test_acc", "train_loss") if c in final]
+    print(final[cols].to_string(index=False))
+    if args.plot:
+        plot_accuracy_grid(df, args.plot)
+        print(f"wrote {args.plot}")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

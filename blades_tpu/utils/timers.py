@@ -1,0 +1,37 @@
+"""Per-phase wall-clock timers — the observability the reference stubs out
+(ref: blades/algorithms/fedavg/fedavg.py:152 creates ``_timers`` and never
+populates them).  Used with explicit ``block_until_ready`` at the call
+sites so async dispatch doesn't fake sub-ms rounds."""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+from typing import Dict
+
+
+class Timers:
+    def __init__(self):
+        self._totals: Dict[str, float] = defaultdict(float)
+        self._counts: Dict[str, int] = defaultdict(int)
+
+    @contextmanager
+    def time(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._totals[name] += time.perf_counter() - t0
+            self._counts[name] += 1
+
+    def mean(self, name: str) -> float:
+        c = self._counts[name]
+        return self._totals[name] / c if c else 0.0
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        return {
+            k: {"mean_s": self.mean(k), "total_s": self._totals[k],
+                "count": self._counts[k]}
+            for k in self._totals
+        }

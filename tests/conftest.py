@@ -1,14 +1,23 @@
 """Test harness: force CPU JAX with 8 virtual devices.
 
 The TPU-native analogue of the reference's "multi-node simulation without a
-cluster" (SURVEY.md §4): multi-chip sharding tests run on a virtual 8-device
-CPU mesh via ``--xla_force_host_platform_device_count``.  Must run before
-jax is imported anywhere in the test process.
+cluster" (SURVEY.md §4): multi-chip sharding tests run on a virtual
+8-device CPU mesh via ``--xla_force_host_platform_device_count``.
+
+This image registers an ``axon`` TPU PJRT plugin from ``sitecustomize`` at
+interpreter start, which force-sets ``jax.config.jax_platforms="axon,cpu"``
+— so the env-var route (``JAX_PLATFORMS=cpu``) is silently overridden.  The
+reliable override is a ``jax.config.update`` after import but before the
+first backend use (pytest imports this conftest before any test module, so
+no backend exists yet).
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,180 @@
+"""Adversary tests (model: blades/adversaries/tests/test_adversary.py — every
+adversary instantiated through the config path, plus semantic checks of the
+attack math)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from blades_tpu.adversaries import (
+    ADVERSARIES,
+    ALIEAdversary,
+    AdaptiveAdversary,
+    AttackclippedclusteringAdversary,
+    IPMAdversary,
+    LabelFlipAdversary,
+    MinMaxAdversary,
+    NoiseAdversary,
+    SignFlipAdversary,
+    SignGuardAdversary,
+    benign_mean_std,
+    get_adversary,
+    make_malicious_mask,
+)
+from blades_tpu.ops.aggregators import Mean, Signguard
+
+N, D, F = 10, 16, 3
+KEY = jax.random.PRNGKey(0)
+
+
+@pytest.fixture
+def updates():
+    return jax.random.normal(KEY, (N, D))
+
+
+@pytest.fixture
+def malicious():
+    return make_malicious_mask(N, F)
+
+
+def test_registry_resolves_all(updates, malicious):
+    for name in ADVERSARIES:
+        adv = get_adversary(
+            name, num_clients=N, num_byzantine=F, num_classes=10
+        )
+        out = adv.on_updates_ready(
+            updates, malicious, KEY, aggregator=Mean(), global_params=None
+        )
+        assert out.shape == updates.shape
+        # Benign rows never touched by update-forging attacks.
+        assert jnp.array_equal(out[F:], updates[F:])
+
+
+def test_dotted_path_resolution():
+    adv = get_adversary("blades.adversaries.IPMAdversary")
+    assert isinstance(adv, IPMAdversary)
+
+
+def test_benign_mean_std_matches_numpy(updates, malicious):
+    mean, std = benign_mean_std(updates, malicious)
+    ref = np.asarray(updates[F:])
+    assert np.allclose(mean, ref.mean(axis=0), atol=1e-5)
+    assert np.allclose(std, ref.std(axis=0, ddof=1), atol=1e-5)
+
+
+def test_alie_forges_mean_plus_zmax_std(updates, malicious):
+    adv = ALIEAdversary(num_clients=N, num_byzantine=F)
+    out = adv.on_updates_ready(updates, malicious, KEY, aggregator=Mean())
+    mean, std = benign_mean_std(updates, malicious)
+    expect = mean + adv.z_max * std
+    assert jnp.allclose(out[0], expect, atol=1e-5)
+    assert jnp.allclose(out[0], out[F - 1])  # all malicious rows identical
+
+
+def test_alie_zmax_value():
+    # n=10, f=3: s = 5+1-3 = 3, cdf = (10-3-3)/(10-3) = 4/7
+    from statistics import NormalDist
+
+    adv = ALIEAdversary(num_clients=10, num_byzantine=3)
+    assert np.isclose(adv.z_max, NormalDist().inv_cdf(4 / 7))
+
+
+def test_alie_signguard_awareness(updates, malicious):
+    adv = ALIEAdversary(num_clients=N, num_byzantine=F)
+    plain = adv.on_updates_ready(updates, malicious, KEY, aggregator=Mean())
+    aware = adv.on_updates_ready(updates, malicious, KEY, aggregator=Signguard())
+    mean, std = benign_mean_std(updates, malicious)
+    # First half of std negated (ref: alie_adversary.py:34-39).
+    assert jnp.allclose(aware[0][: D // 2], (mean - adv.z_max * std)[: D // 2], atol=1e-5)
+    assert jnp.allclose(aware[0][D // 2 :], plain[0][D // 2 :], atol=1e-5)
+
+
+def test_ipm_negates_scaled_mean(updates, malicious):
+    adv = IPMAdversary(scale=0.5)
+    out = adv.on_updates_ready(updates, malicious, KEY)
+    mean, _ = benign_mean_std(updates, malicious)
+    assert jnp.allclose(out[0], -0.5 * mean, atol=1e-6)
+
+
+def test_noise_rows_are_independent(updates, malicious):
+    adv = NoiseAdversary(mean=0.0, std=1.0)
+    out = adv.on_updates_ready(updates, malicious, KEY)
+    assert not jnp.allclose(out[0], out[1])
+    assert jnp.array_equal(out[F:], updates[F:])
+
+
+def test_minmax_respects_distance_threshold(updates, malicious):
+    adv = MinMaxAdversary()
+    out = adv.on_updates_ready(updates, malicious, KEY, aggregator=Mean())
+    forged = out[0]
+    benign = np.asarray(updates[F:])
+    threshold = max(
+        np.linalg.norm(a - b) for a in benign for b in benign
+    )
+    max_dist = max(np.linalg.norm(np.asarray(forged) - b) for b in benign)
+    assert max_dist <= threshold * 1.05  # binary search converged below threshold
+
+
+def test_adaptive_pushes_beyond_extremes(updates, malicious):
+    adv = AdaptiveAdversary()
+    out = adv.on_updates_ready(updates, malicious, KEY)
+    forged = np.asarray(out[0])
+    benign = np.asarray(updates[F:])
+    mean = benign.mean(axis=0)
+    mx, mn = benign.max(axis=0), benign.min(axis=0)
+    s = np.sign(mean)
+    # Where s=-1: forged >= max; where s=+1: forged <= min (the Fang
+    # construction pushes outside the benign envelope in the harmful
+    # direction, ref: adaptive_adversary.py:33-56).
+    assert (forged[s == -1] >= mx[s == -1] - 1e-5).all()
+    assert (forged[s == 1] <= mn[s == 1] + 1e-5).all()
+
+
+def test_signguard_attack_sign_census(updates, malicious):
+    adv = SignGuardAdversary()
+    out = adv.on_updates_ready(updates, malicious, KEY)
+    forged = np.asarray(out[0])
+    mean, _ = benign_mean_std(updates, malicious)
+    mean = np.asarray(mean)
+    assert (forged > 0).sum() == (mean > 0).sum()
+    assert (forged < 0).sum() == (mean < 0).sum()
+    assert (np.abs(forged) <= 1.0).all()
+
+
+def test_attackclippedclustering_runs_and_forges(updates, malicious):
+    adv = AttackclippedclusteringAdversary()
+    out = adv.on_updates_ready(updates, malicious, KEY)
+    assert jnp.isfinite(out).all()
+    assert not jnp.allclose(out[0], updates[0])
+
+
+def test_labelflip_hook(malicious):
+    adv = LabelFlipAdversary(num_classes=10)
+    y = jnp.array([0, 1, 9])
+    # Malicious lane flips, benign doesn't.
+    _, y_mal = adv.data_hook(None, y, jnp.array(True))
+    _, y_ben = adv.data_hook(None, y, jnp.array(False))
+    assert jnp.array_equal(y_mal, jnp.array([9, 8, 0]))
+    assert jnp.array_equal(y_ben, y)
+
+
+def test_signflip_hook():
+    adv = SignFlipAdversary()
+    grads = {"w": jnp.ones((3,)), "b": jnp.array(-2.0)}
+    flipped = adv.grad_hook(grads, jnp.array(True))
+    kept = adv.grad_hook(grads, jnp.array(False))
+    assert jnp.array_equal(flipped["w"], -jnp.ones((3,)))
+    assert float(flipped["b"]) == 2.0
+    assert jnp.array_equal(kept["w"], grads["w"])
+
+
+def test_update_attacks_jit_compatible(updates, malicious):
+    adv = ALIEAdversary(num_clients=N, num_byzantine=F)
+
+    @jax.jit
+    def run(u, m, k):
+        return adv.on_updates_ready(u, m, k, aggregator=Mean())
+
+    out = run(updates, malicious, KEY)
+    assert out.shape == updates.shape

@@ -1,0 +1,58 @@
+"""Model zoo tests (model: fllib/models/tests/test_models.py)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from blades_tpu.models import MLP, FashionCNN, ModelCatalog, register_model
+from blades_tpu.models.layers import BatchStatsNorm
+
+
+@pytest.mark.parametrize(
+    "name,shape",
+    [("mlp", (2, 28, 28, 1)), ("cnn", (2, 28, 28, 1)),
+     ("resnet10", (2, 32, 32, 3)), ("cct", (2, 32, 32, 3))],
+)
+def test_catalog_forward_shapes(name, shape):
+    m = ModelCatalog.get_model(name)
+    params = m.init(jax.random.PRNGKey(0), jnp.zeros(shape))
+    out = m.apply(params, jnp.zeros(shape))
+    assert out.shape == (shape[0], 10)
+
+
+def test_catalog_substring_matching():
+    # Same matching rule as ref: fllib/models/catalog.py:16-29.
+    assert isinstance(ModelCatalog.get_model("mlp_special"), MLP)
+    assert isinstance(ModelCatalog.get_model("my_cnn"), FashionCNN)
+
+
+def test_catalog_passthrough_module():
+    m = MLP()
+    assert ModelCatalog.get_model(m) is m
+
+
+def test_custom_model_registration():
+    register_model("tinynet", lambda num_classes=10: MLP(hidden1=4, hidden2=4,
+                                                         num_classes=num_classes))
+    m = ModelCatalog.get_model("tinynet", num_classes=3)
+    params = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1)))
+    assert m.apply(params, jnp.zeros((1, 28, 28, 1))).shape == (1, 3)
+
+
+def test_batch_stats_norm_is_stateless_and_normalises():
+    m = BatchStatsNorm()
+    x = jax.random.normal(jax.random.PRNGKey(0), (64, 8)) * 5.0 + 3.0
+    params = m.init(jax.random.PRNGKey(1), x)
+    # Pure function of params: no batch_stats collection exists.
+    assert set(params.keys()) == {"params"}
+    y = m.apply(params, x)
+    assert jnp.allclose(y.mean(axis=0), 0.0, atol=1e-4)
+    assert jnp.allclose(y.std(axis=0), 1.0, atol=1e-2)
+
+
+def test_models_are_pure_no_mutable_collections():
+    # The FL-soundness property: track_running_stats=False analogue
+    # (ref: fllib/models/cifar10/resnet_cifar.py:14).
+    m = ModelCatalog.get_model("resnet10")
+    variables = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
+    assert set(variables.keys()) == {"params"}

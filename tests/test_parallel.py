@@ -1,0 +1,116 @@
+"""Multi-chip sharding tests on the 8-virtual-device CPU mesh
+(the analogue of the reference's multi-node-without-a-cluster testing,
+SURVEY.md §4; conftest.py forces the device count)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from blades_tpu.adversaries import get_adversary, make_malicious_mask
+from blades_tpu.core import FedRound, Server, TaskSpec
+from blades_tpu.data import DatasetCatalog
+from blades_tpu.parallel import (
+    make_mesh,
+    shard_federation,
+    shard_map_step,
+    sharded_step,
+)
+from blades_tpu.parallel.sharded import sharded_evaluate
+
+N_CLIENTS = 16  # 2 per device
+
+
+@pytest.fixture(scope="module")
+def setup():
+    assert jax.device_count() == 8, "conftest must provide 8 virtual devices"
+    ds = DatasetCatalog.get_dataset("mnist", num_clients=N_CLIENTS)
+    task = TaskSpec(model="mlp", lr=0.1, input_shape=(28, 28, 1)).build()
+    server = Server.from_config(aggregator="Median", lr=1.0)
+    adv = get_adversary("ALIE", num_clients=N_CLIENTS, num_byzantine=4)
+    fr = FedRound(task=task, server=server, adversary=adv, batch_size=16)
+    mesh = make_mesh()
+    state = fr.init(jax.random.PRNGKey(0), N_CLIENTS)
+    arrays = (
+        jnp.array(ds.train.x), jnp.array(ds.train.y),
+        jnp.array(ds.train.lengths), make_malicious_mask(N_CLIENTS, 4),
+    )
+    state, arrays = shard_federation(mesh, state, arrays)
+    return ds, fr, mesh, state, arrays
+
+
+def test_mesh_construction():
+    mesh = make_mesh()
+    assert mesh.devices.shape == (8,)
+    assert mesh.axis_names == ("clients",)
+    small = make_mesh(num_devices=4)
+    assert small.devices.shape == (4,)
+
+
+def test_shard_placement(setup):
+    _, _, mesh, state, arrays = setup
+    x = arrays[0]
+    # Client data sharded over 8 devices; server params replicated.
+    assert len(x.sharding.device_set) == 8
+    p = jax.tree.leaves(state.server.params)[0]
+    assert p.sharding.is_fully_replicated
+
+
+def test_gspmd_step_runs_and_learns(setup):
+    ds, fr, mesh, state, (x, y, ln, mal) = setup
+    step = sharded_step(fr, mesh, donate=False)
+    losses = []
+    for r in range(15):
+        state, m = step(state, x, y, ln, mal, jax.random.fold_in(jax.random.PRNGKey(5), r))
+        losses.append(float(m["train_loss"]))
+    assert losses[-1] < losses[0]
+    ev = sharded_evaluate(fr, mesh)(
+        state,
+        *shard_federation(mesh, state, (
+            jnp.array(ds.test.x), jnp.array(ds.test.y), jnp.array(ds.test.lengths)
+        ))[1],
+    )
+    assert float(ev["test_acc"]) > 0.5
+
+
+def test_shard_map_step_matches_semantics(setup):
+    ds, fr, mesh, state, (x, y, ln, mal) = setup
+    step = shard_map_step(fr, mesh)
+    st = state
+    for r in range(10):
+        st, m = step(st, x, y, ln, mal, jax.random.fold_in(jax.random.PRNGKey(6), r))
+    assert np.isfinite(float(m["train_loss"]))
+    assert int(m["round"]) == 10
+    # Forged rows present: ALIE makes malicious updates identical.
+    # (indirect check: training still converges under the attack+defense)
+    ev = sharded_evaluate(fr, mesh)(
+        st,
+        *shard_federation(mesh, st, (
+            jnp.array(ds.test.x), jnp.array(ds.test.y), jnp.array(ds.test.lengths)
+        ))[1],
+    )
+    assert float(ev["test_acc"]) > 0.5
+
+
+def test_gspmd_matches_single_device_numerics(setup):
+    """The sharded GSPMD program must be bit-identical (up to float assoc)
+    to the unsharded jit of the same function with the same keys."""
+    ds, fr, mesh, state, (x, y, ln, mal) = setup
+    plain_state = fr.init(jax.random.PRNGKey(0), N_CLIENTS)
+    step_sharded = sharded_step(fr, mesh, donate=False)
+    step_plain = jax.jit(fr.step)
+    s1, s2 = state, plain_state
+    for r in range(3):
+        key = jax.random.fold_in(jax.random.PRNGKey(8), r)
+        s1, m1 = step_sharded(s1, x, y, ln, mal, key)
+        s2, m2 = step_plain(
+            s2, jnp.array(ds.train.x), jnp.array(ds.train.y),
+            jnp.array(ds.train.lengths), mal, key,
+        )
+    from blades_tpu.utils.tree import ravel_fn
+
+    ravel, _, _ = ravel_fn(s2.server.params)
+    np.testing.assert_allclose(
+        np.asarray(ravel(s1.server.params)), np.asarray(ravel(s2.server.params)),
+        rtol=2e-4, atol=2e-5,
+    )

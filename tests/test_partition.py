@@ -1,0 +1,103 @@
+"""Partitioner tests (model: fllib/datasets/tests/test_dataset.py)."""
+
+import numpy as np
+import pytest
+
+from blades_tpu.data.partition import (
+    dirichlet_partition,
+    iid_partition,
+    partition_dataset,
+    partition_proportions,
+)
+
+
+def test_iid_partition_covers_all_indices():
+    shards = iid_partition(103, 7, seed=0)
+    allidx = np.sort(np.concatenate(shards))
+    assert np.array_equal(allidx, np.arange(103))
+    sizes = [len(s) for s in shards]
+    assert max(sizes) - min(sizes) <= 1
+
+
+def test_iid_partition_deterministic():
+    a = iid_partition(100, 5, seed=42)
+    b = iid_partition(100, 5, seed=42)
+    for x, y in zip(a, b):
+        assert np.array_equal(x, y)
+    c = iid_partition(100, 5, seed=43)
+    assert any(not np.array_equal(x, y) for x, y in zip(a, c))
+
+
+def test_dirichlet_partition_covers_and_respects_min_size():
+    labels = np.repeat(np.arange(10), 100)
+    shards = dirichlet_partition(labels, 8, alpha=0.1, seed=0)
+    allidx = np.sort(np.concatenate(shards))
+    assert np.array_equal(allidx, np.arange(1000))
+    assert min(len(s) for s in shards) >= 10
+
+
+def test_dirichlet_skew_increases_as_alpha_drops():
+    labels = np.repeat(np.arange(10), 200)
+
+    def skew(alpha):
+        shards = dirichlet_partition(labels, 10, alpha=alpha, seed=1)
+        part = partition_dataset(
+            np.zeros((2000, 1), np.float32), labels, 10, iid=False, alpha=alpha, seed=1
+        )
+        props = partition_proportions(part, 10).astype(float)
+        props /= props.sum(axis=1, keepdims=True)
+        # Mean per-client entropy: lower = more skew.
+        with np.errstate(divide="ignore", invalid="ignore"):
+            ent = -np.nansum(np.where(props > 0, props * np.log(props), 0.0), axis=1)
+        return ent.mean()
+
+    assert skew(0.1) < skew(10.0)
+
+
+def test_partition_dataset_padding_is_cyclic_real_rows():
+    x = np.arange(40, dtype=np.float32).reshape(20, 2)
+    y = np.arange(20, dtype=np.int32)
+    part = partition_dataset(x, y, 3, iid=True, seed=0)
+    for i in range(3):
+        n = part.lengths[i]
+        real = set(map(tuple, part.x[i, :n]))
+        padded = set(map(tuple, part.x[i, n:]))
+        assert padded <= real  # padding rows are copies of the client's own rows
+
+
+def test_partition_dataset_max_shard_cap():
+    x = np.zeros((100, 2), np.float32)
+    y = np.zeros(100, np.int32)
+    part = partition_dataset(x, y, 4, iid=True, seed=0, max_shard=10)
+    assert part.x.shape == (4, 10, 2)
+    assert (part.lengths == 10).all()
+
+
+def test_synthetic_dataset_seed_determinism():
+    from blades_tpu.data import DatasetCatalog
+
+    a = DatasetCatalog.get_dataset("mnist", num_clients=4, seed=0)
+    b = DatasetCatalog.get_dataset("mnist", num_clients=4, seed=0)
+    c = DatasetCatalog.get_dataset("mnist", num_clients=4, seed=1)
+    assert np.array_equal(a.train.x, b.train.x)
+    if a.synthetic:  # different seed must give different synthetic data
+        assert not np.array_equal(a.train.x, c.train.x)
+
+
+def test_random_crop_flip_augmentation():
+    import jax
+    import jax.numpy as jnp
+
+    from blades_tpu.data.augment import get_augmentation, random_crop_flip
+
+    x = jnp.arange(2 * 8 * 8 * 3, dtype=jnp.float32).reshape(2, 8, 8, 3)
+    key = jax.random.PRNGKey(0)
+    out = random_crop_flip(key, x, padding=2)
+    assert out.shape == x.shape
+    # Deterministic per key; different keys give different crops.
+    assert jnp.array_equal(out, random_crop_flip(key, x, padding=2))
+    assert not jnp.array_equal(out, random_crop_flip(jax.random.PRNGKey(1), x, padding=2))
+    # Pixel multiset is preserved or zero-padded, never invented.
+    assert out.max() <= x.max()
+    assert get_augmentation("cifar") is random_crop_flip
+    assert get_augmentation(None) is None

@@ -1,0 +1,107 @@
+"""Sweep / CLI tests (model: blades/train.py behavior, SURVEY.md §2.1)."""
+
+import json
+from pathlib import Path
+
+import pytest
+import yaml
+
+from blades_tpu.tune import expand_grid, load_experiments_from_file, run_experiments
+
+
+def test_expand_grid_no_grids():
+    cfg = {"a": 1, "b": {"c": 2}}
+    assert expand_grid(cfg) == [cfg]
+
+
+def test_expand_grid_cartesian_product():
+    cfg = {
+        "x": {"grid_search": [1, 2]},
+        "nested": {"y": {"grid_search": ["a", "b", "c"]}},
+        "fixed": 0,
+    }
+    trials = expand_grid(cfg)
+    assert len(trials) == 6
+    assert {(t["x"], t["nested"]["y"]) for t in trials} == {
+        (i, s) for i in (1, 2) for s in "abc"
+    }
+    assert all(t["fixed"] == 0 for t in trials)
+
+
+def test_expand_grid_dict_values():
+    cfg = {"agg": {"grid_search": [{"type": "Mean"}, {"type": "Median"}]}}
+    trials = expand_grid(cfg)
+    assert [t["agg"]["type"] for t in trials] == ["Mean", "Median"]
+
+
+def test_load_experiments_requires_run(tmp_path):
+    f = tmp_path / "bad.yaml"
+    f.write_text(yaml.safe_dump({"exp": {"config": {}}}))
+    with pytest.raises(ValueError, match="run"):
+        load_experiments_from_file(str(f))
+
+
+def test_run_experiments_end_to_end(tmp_path):
+    experiments = {
+        "smoke": {
+            "run": "FEDAVG",
+            "stop": {"training_iteration": 6},
+            "config": {
+                "dataset_config": {"type": "mnist", "num_clients": 6, "train_bs": 16},
+                "global_model": "mlp",
+                "evaluation_interval": 3,
+                "server_config": {"lr": 1.0,
+                                  "aggregator": {"grid_search": [
+                                      {"type": "Mean"}, {"type": "Median"}]}},
+            },
+        }
+    }
+    summaries = run_experiments(
+        experiments, storage_path=str(tmp_path), verbose=0, checkpoint_at_end=True
+    )
+    assert len(summaries) == 2  # aggregator grid
+    for s in summaries:
+        tdir = Path(s["dir"])
+        lines = (tdir / "result.json").read_text().strip().splitlines()
+        assert len(lines) == 6
+        last = json.loads(lines[-1])
+        assert last["training_iteration"] == 6
+        assert "test_acc" in last
+        assert (tdir / "ckpt_final" / "algorithm_state.pkl").exists()
+        assert (tdir / "params.json").exists()
+        assert s["best_test_acc"] > 0.3
+
+
+def test_cli_file_command(tmp_path):
+    from blades_tpu.train import main
+
+    exp = {
+        "cli_smoke": {
+            "run": "FEDAVG",
+            "stop": {"training_iteration": 3},
+            "config": {
+                "dataset_config": {"type": "mnist", "num_clients": 4, "train_bs": 8},
+                "global_model": "mlp",
+                "evaluation_interval": 3,
+                "server_config": {"lr": 1.0},
+            },
+        }
+    }
+    f = tmp_path / "exp.yaml"
+    f.write_text(yaml.safe_dump(exp))
+    rc = main(["file", str(f), "--storage-path", str(tmp_path / "out")])
+    assert rc == 0
+    assert (tmp_path / "out" / "cli_smoke").exists()
+
+
+def test_tuned_examples_parse_and_expand():
+    """Every shipped YAML grid must load and expand (the reference's
+    tuned_examples are its canonical envelope, SURVEY.md §6)."""
+    root = Path(__file__).parent.parent / "blades_tpu" / "tuned_examples"
+    yamls = sorted(root.glob("*.yaml"))
+    assert len(yamls) >= 5
+    for y in yamls:
+        exps = load_experiments_from_file(str(y))
+        for name, spec in exps.items():
+            trials = expand_grid(spec["config"])
+            assert len(trials) >= 1
