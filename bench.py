@@ -1,14 +1,16 @@
 """Benchmark: FL rounds/sec, FedAvg + ALIE + Median on CIFAR-10/ResNet-18.
 
 The BASELINE.json headline workload scaled to the available chip: N clients
-run vmapped local SGD on ResNet-18, ALIE forges the Byzantine lanes, the
-server aggregates with coordinate-wise Median.  Metric = full FL rounds/sec
-(local train + attack + robust aggregate + server step, all on device).
+run vmapped local SGD on ResNet-18 (bf16 compute, f32 master params), ALIE
+forges the Byzantine lanes, the server aggregates with coordinate-wise
+Median.  Rounds are fused ``CHUNK`` at a time into one XLA dispatch
+(``FedRound.multi_step``).  Metric = full FL rounds/sec (local train +
+attack + robust aggregate + server step, all on device).
 
 ``vs_baseline`` compares against the reference envelope: the Ray/GPU
 reference at its canonical 60-client CIFAR-10/ResNet config is bounded by
 per-round Python/actor overhead at ~1 round/sec on a single GPU (SURVEY.md
-§6: 2000 rounds is a multi-hour budget); the north-star asks ≥10x.  We
+§6: 2000 rounds is a multi-hour budget); the north-star asks >=10x.  We
 report measured rounds/sec divided by that 1.0 round/sec envelope.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
@@ -18,6 +20,7 @@ from __future__ import annotations
 
 import json
 import time
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -27,7 +30,8 @@ NUM_CLIENTS = 64
 NUM_BYZANTINE = 12
 BATCH = 32
 SHARD = 64
-ROUNDS = 20
+CHUNK = 10  # rounds fused per dispatch
+NUM_CHUNKS = 3
 BASELINE_ROUNDS_PER_SEC = 1.0
 
 
@@ -36,7 +40,7 @@ def main() -> None:
     from blades_tpu.core import FedRound, Server, TaskSpec
 
     task = TaskSpec(model="resnet18", input_shape=(32, 32, 3), num_classes=10,
-                    lr=0.1).build()
+                    lr=0.1, compute_dtype="bfloat16").build()
     server = Server.from_config(aggregator="Median", lr=0.5)
     adv = get_adversary("ALIE", num_clients=NUM_CLIENTS, num_byzantine=NUM_BYZANTINE)
     fr = FedRound(task=task, server=server, adversary=adv, batch_size=BATCH,
@@ -49,23 +53,23 @@ def main() -> None:
     mal = make_malicious_mask(NUM_CLIENTS, NUM_BYZANTINE)
 
     state = fr.init(jax.random.PRNGKey(0), NUM_CLIENTS)
-    step = jax.jit(fr.step, donate_argnums=(0,))
+    step = jax.jit(partial(fr.multi_step, num_rounds=CHUNK), donate_argnums=(0,))
 
     # Warmup / compile.
-    state, _ = step(state, x, y, lengths, mal, jax.random.PRNGKey(1))
-    jax.block_until_ready(state)
+    state, m = step(state, x, y, lengths, mal, jax.random.PRNGKey(1))
+    _ = float(m["train_loss"][-1])
 
     t0 = time.perf_counter()
-    for r in range(ROUNDS):
+    for c in range(NUM_CHUNKS):
         state, metrics = step(state, x, y, lengths, mal,
-                              jax.random.fold_in(jax.random.PRNGKey(2), r))
+                              jax.random.fold_in(jax.random.PRNGKey(2), c))
     # Fetch a concrete value from the final round: forces the whole chain.
     # (block_until_ready alone returns early through the axon tunnel.)
-    final_loss = float(metrics["train_loss"])
+    final_loss = float(metrics["train_loss"][-1])
     assert final_loss == final_loss  # NaN guard
     dt = time.perf_counter() - t0
 
-    rounds_per_sec = ROUNDS / dt
+    rounds_per_sec = (CHUNK * NUM_CHUNKS) / dt
     print(json.dumps({
         "metric": "fl_rounds_per_sec_fedavg_alie_median_cifar10_resnet18_64clients",
         "value": round(rounds_per_sec, 3),

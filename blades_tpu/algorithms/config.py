@@ -62,6 +62,10 @@ class FedavgConfig:
         self.dp_noise_factor: Optional[float] = None
         # train-time augmentation; "auto" = by dataset (cifar10 -> crop+flip)
         self.augment: Any = "auto"
+        # mixed-precision compute dtype (e.g. "bfloat16"); params stay f32
+        self.compute_dtype: Any = None
+        # rounds fused per device dispatch (lax.scan); 1 = round-per-call
+        self.rounds_per_dispatch: int = 1
         # server root-dataset size for trust-bootstrapped aggregators (FLTrust)
         self.fltrust_root_size: int = 100
         # resources
@@ -207,6 +211,7 @@ class FedavgConfig:
             model=self.global_model, num_classes=self.num_classes,
             input_shape=tuple(self.input_shape), lr=self.client_lr,
             momentum=self.client_momentum, augment=augment,
+            compute_dtype=self.compute_dtype,
         )
 
     def get_server(self) -> Server:

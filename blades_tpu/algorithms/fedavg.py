@@ -66,10 +66,11 @@ class Fedavg:
             jnp.asarray(self.dataset.test.lengths),
         )
 
+        self._chunk = max(1, int(getattr(cfg, "rounds_per_dispatch", 1)))
         self.mesh = None
         if cfg.num_devices and cfg.num_devices > 1:
             from blades_tpu.parallel import make_mesh, shard_federation, sharded_step
-            from blades_tpu.parallel.sharded import sharded_evaluate
+            from blades_tpu.parallel.sharded import sharded_evaluate, sharded_multi_step
 
             self.mesh = make_mesh(num_devices=cfg.num_devices)
             self.state, arrays = shard_federation(
@@ -79,10 +80,22 @@ class Fedavg:
             _, self._test_arrays = shard_federation(
                 self.mesh, self.state, self._test_arrays
             )
-            self._step = sharded_step(self.fed_round, self.mesh, donate=False)
+            if self._chunk > 1:
+                self._step = sharded_multi_step(
+                    self.fed_round, self.mesh, self._chunk, donate=False
+                )
+            else:
+                self._step = sharded_step(self.fed_round, self.mesh, donate=False)
             self._evaluate = sharded_evaluate(self.fed_round, self.mesh)
         else:
-            self._step = jax.jit(self.fed_round.step)
+            if self._chunk > 1:
+                from functools import partial
+
+                self._step = jax.jit(
+                    partial(self.fed_round.multi_step, num_rounds=self._chunk)
+                )
+            else:
+                self._step = jax.jit(self.fed_round.step)
             self._evaluate = jax.jit(self.fed_round.evaluate)
 
         self.timers = Timers()
@@ -115,7 +128,8 @@ class Fedavg:
         return self._iteration
 
     def train(self) -> Dict:
-        """One FL round + periodic eval, returns the round's result dict."""
+        """One training dispatch (= ``rounds_per_dispatch`` FL rounds, 1 by
+        default) + periodic eval, returns the last round's result dict."""
         round_key, self._key = jax.random.split(self._key)
         with self.timers.time("training_step"):
             self.state, metrics = self._step(
@@ -123,8 +137,11 @@ class Fedavg:
             )
             # Concrete fetches inside the timer: block_until_ready alone can
             # return early through remote-execution tunnels.
-            metrics = {k: float(v) for k, v in metrics.items()}
-        self._iteration += 1
+            metrics = {
+                k: float(v[-1] if getattr(v, "ndim", 0) else v)
+                for k, v in metrics.items()
+            }
+        self._iteration += self._chunk
         result = {
             "training_iteration": self._iteration,
             "train_loss": metrics["train_loss"],

@@ -1,0 +1,2 @@
+"""Standalone (non-FL) training sanity baselines
+(ref: blades/benchmarks/main.py)."""

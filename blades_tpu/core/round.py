@@ -144,6 +144,31 @@ class FedRound:
         }
         return RoundState(server=server, client_opt=client_opt), metrics
 
+    def multi_step(
+        self,
+        state: RoundState,
+        data_x: jax.Array,
+        data_y: jax.Array,
+        lengths: jax.Array,
+        malicious: jax.Array,
+        key: jax.Array,
+        num_rounds: int,
+    ) -> Tuple[RoundState, dict]:
+        """``num_rounds`` FL rounds as ONE ``lax.scan``-ed XLA program.
+
+        The hot-loop form: host dispatch (and, under remote-execution
+        relays, per-call latency) is paid once per chunk instead of once
+        per round.  Metrics come back stacked ``(num_rounds, ...)``.
+        Jit with ``static_argnums`` on ``num_rounds`` or wrap in a
+        functools.partial.
+        """
+
+        def body(st, k):
+            return self.step(st, data_x, data_y, lengths, malicious, k)
+
+        keys = jax.random.split(key, num_rounds)
+        return jax.lax.scan(body, state, keys)
+
     def compute_trusted_update(self, global_params, key) -> Optional[jax.Array]:
         """The server's own local round on its clean root data (FLTrust's
         trusted reference, Cao et al. arXiv:2012.13995).  Fresh optimizer

@@ -63,6 +63,10 @@ class TaskSpec:
     # Keyed train-time augmentation ("cifar" = random crop + flip, the
     # reference's loader transforms, ref: fllib/datasets/cifar10.py:56-64).
     augment: Any = None
+    # Mixed precision: forward/backward in this dtype (params, optimizer
+    # state and the update vector stay f32 — standard bf16-compute/f32-master
+    # recipe; bfloat16 feeds the MXU at full rate).
+    compute_dtype: Any = None  # e.g. "bfloat16"
 
     def build(self) -> "Task":
         model = ModelCatalog.get_model(self.model, num_classes=self.num_classes)
@@ -96,8 +100,17 @@ class Task:
         return self.model.apply({"params": params}, x, train=train, rngs=rngs)
 
     def loss_fn(self, params, x, y, dropout_key=None):
+        if self.spec.compute_dtype is not None:
+            dt = jnp.dtype(self.spec.compute_dtype)
+            params = jax.tree.map(
+                lambda p: p.astype(dt) if jnp.issubdtype(p.dtype, jnp.floating) else p,
+                params,
+            )
+            x = x.astype(dt)
         logits = self.apply(params, x, train=True, dropout_key=dropout_key)
-        ce = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+        ce = optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), y
+        ).mean()
         return jnp.clip(ce, 0.0, self.spec.loss_clamp)
 
     def train_one_batch(

@@ -19,6 +19,11 @@ program:
 - :func:`shard_map_step` — explicit ``shard_map``: per-device local rounds
   + hand-placed ``all_gather`` of the update matrix, for when collective
   placement must be controlled.
+- :func:`dsharded_step` — the giant-federation formulation: one
+  ``all_to_all`` re-shards the update matrix from client-rows to
+  width-shards so the full ``(n, d)`` never materialises on any device
+  (the 1000-client x 11M-param memory wall, SURVEY.md §7.3); row geometry
+  is recovered exactly via ``psum`` of shard-partial Gram terms.
 
 Multi-host (DCN) attaches via :func:`init_distributed`.
 """
@@ -30,4 +35,5 @@ from blades_tpu.parallel.mesh import (  # noqa: F401
     replicated_sharding,
     shard_federation,
 )
+from blades_tpu.parallel.dsharded import dsharded_step  # noqa: F401
 from blades_tpu.parallel.sharded import shard_map_step, sharded_step  # noqa: F401

@@ -25,6 +25,10 @@ def main(argv=None) -> int:
     p_file.add_argument("--checkpoint-at-end", action="store_true")
     p_file.add_argument("--max-rounds", type=int, default=None,
                         help="override every experiment's training_iteration")
+    p_file.add_argument("--trace", default=None, metavar="DIR",
+                        help="capture a jax profiler trace into DIR "
+                        "(the reference's --trace flag is dead code; this "
+                        "one works)")
     p_file.add_argument("-v", "--verbose", action="count", default=1)
 
     p_run = sub.add_parser("run", help="run one algorithm with overrides")
@@ -42,14 +46,24 @@ def main(argv=None) -> int:
 
     if args.cmd == "file":
         experiments = load_experiments_from_file(args.experiment_file)
-        summaries = run_experiments(
-            experiments,
-            storage_path=args.storage_path,
-            verbose=args.verbose,
-            checkpoint_freq=args.checkpoint_freq,
-            checkpoint_at_end=args.checkpoint_at_end,
-            max_rounds_override=args.max_rounds,
-        )
+
+        def _run():
+            return run_experiments(
+                experiments,
+                storage_path=args.storage_path,
+                verbose=args.verbose,
+                checkpoint_freq=args.checkpoint_freq,
+                checkpoint_at_end=args.checkpoint_at_end,
+                max_rounds_override=args.max_rounds,
+            )
+
+        if args.trace:
+            from blades_tpu.utils.profiling import trace
+
+            with trace(args.trace):
+                summaries = _run()
+        else:
+            summaries = _run()
     else:
         experiments = {
             f"{args.algo.lower()}_run": {

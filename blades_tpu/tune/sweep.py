@@ -117,7 +117,9 @@ def run_experiments(
                 print(f"== trial {tname}: {max_rounds} rounds ==", flush=True)
             best_acc, t0 = 0.0, time.perf_counter()
             with open(tdir / "result.json", "w") as f:
-                for _ in range(max_rounds):
+                # Stop on training_iteration (actual FL rounds), not train()
+                # calls — one call advances rounds_per_dispatch rounds.
+                while algo.iteration < max_rounds:
                     result = algo.train()
                     result["trial"] = tname
                     f.write(json.dumps(_jsonable(result)) + "\n")
@@ -130,8 +132,8 @@ def run_experiments(
                 algo.save_checkpoint(str(tdir / "ckpt_final"))
             wall = time.perf_counter() - t0
             summary = {
-                "trial": tname, "rounds": max_rounds, "wall_s": round(wall, 2),
-                "rounds_per_sec": round(max_rounds / wall, 2),
+                "trial": tname, "rounds": algo.iteration, "wall_s": round(wall, 2),
+                "rounds_per_sec": round(algo.iteration / wall, 2),
                 "best_test_acc": best_acc, "final": algo._last_eval,
                 "dir": str(tdir),
             }

@@ -1,0 +1,40 @@
+"""Profiling / tracing — the observability the reference stubs
+(ref: blades/train.py:343-346's dead ``--trace`` flag; SURVEY.md §5).
+
+- :func:`trace` — context manager around ``jax.profiler`` producing a
+  TensorBoard-loadable trace directory.
+- :func:`annotate` — named region inside a trace (host-side).
+- :func:`xla_dump_flags` — the XLA_FLAGS string to dump HLO for a run
+  (must be set before the first compilation).
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Iterator
+
+
+@contextmanager
+def trace(log_dir: str) -> Iterator[None]:
+    """Capture a jax profiler trace (device + host) into ``log_dir``."""
+    import jax.profiler
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named sub-region, visible in the trace viewer."""
+    import jax.profiler
+
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+def xla_dump_flags(dump_dir: str) -> str:
+    """XLA_FLAGS value that dumps optimised HLO text to ``dump_dir``."""
+    return f"--xla_dump_to={dump_dir} --xla_dump_hlo_as_text"

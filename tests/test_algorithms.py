@@ -175,3 +175,15 @@ def test_cifar_config_gets_augmentation():
     cfg2 = FedavgConfig().data(dataset="mnist", num_clients=4)
     cfg2.validate()
     assert cfg2.get_task_spec().augment is None
+
+
+def test_rounds_per_dispatch_chunked_driver():
+    cfg = tiny_config()
+    cfg.rounds_per_dispatch = 5
+    cfg.evaluation_interval = 5
+    algo = cfg.build()
+    r = algo.train()
+    assert r["training_iteration"] == 5
+    assert "test_acc" in r  # eval fired at iteration 5
+    r = algo.train()
+    assert r["training_iteration"] == 10

@@ -114,3 +114,53 @@ def test_lr_schedule_piecewise():
     assert np.isclose(float(sched(100)), 0.01, atol=1e-4)
     # Linear interpolation midway.
     assert 0.01 < float(sched(50)) < 0.1
+
+
+def test_multi_step_matches_sequential_steps(tiny):
+    """multi_step(k) must advance the same state machine as k step() calls
+    with the same per-round keys (jax.random.split of the chunk key)."""
+    ds, fr, _, (x, y, ln) = tiny
+    from functools import partial
+
+    mal = jnp.zeros(6, bool)
+    chunk_key = jax.random.PRNGKey(11)
+    st_a = fr.init(jax.random.PRNGKey(1), 6)
+    st_b = fr.init(jax.random.PRNGKey(1), 6)
+
+    st_a, ms = jax.jit(partial(fr.multi_step, num_rounds=3))(
+        st_a, x, y, ln, mal, chunk_key
+    )
+    step = jax.jit(fr.step)
+    keys = jax.random.split(chunk_key, 3)
+    for i in range(3):
+        st_b, m = step(st_b, x, y, ln, mal, keys[i])
+
+    ravel, _, _ = ravel_fn(st_b.server.params)
+    np.testing.assert_allclose(
+        np.asarray(ravel(st_a.server.params)),
+        np.asarray(ravel(st_b.server.params)), rtol=1e-6,
+    )
+    assert ms["train_loss"].shape == (3,)
+    np.testing.assert_allclose(float(ms["train_loss"][-1]), float(m["train_loss"]),
+                               rtol=1e-6)
+    assert int(st_a.server.round) == 3
+
+
+def test_bf16_compute_learns(tiny):
+    ds, _, _, (x, y, ln) = tiny
+    from blades_tpu.core import FedRound, Server, TaskSpec
+
+    task = TaskSpec(model="mlp", lr=0.1, input_shape=(28, 28, 1),
+                    compute_dtype="bfloat16").build()
+    fr = FedRound(task=task, server=Server.from_config(aggregator="Mean", lr=1.0),
+                  batch_size=16)
+    st = fr.init(jax.random.PRNGKey(0), 6)
+    # Params stay f32 masters.
+    assert all(p.dtype == jnp.float32 for p in jax.tree.leaves(st.server.params))
+    step = jax.jit(fr.step)
+    losses = []
+    mal = jnp.zeros(6, bool)
+    for r in range(20):
+        st, m = step(st, x, y, ln, mal, jax.random.fold_in(jax.random.PRNGKey(3), r))
+        losses.append(float(m["train_loss"]))
+    assert losses[-1] < losses[0] * 0.6

@@ -105,3 +105,25 @@ def test_tuned_examples_parse_and_expand():
         for name, spec in exps.items():
             trials = expand_grid(spec["config"])
             assert len(trials) >= 1
+
+
+def test_run_experiments_counts_rounds_not_calls(tmp_path):
+    """With rounds_per_dispatch > 1, the stop criterion is FL rounds."""
+    experiments = {
+        "chunked": {
+            "run": "FEDAVG",
+            "stop": {"training_iteration": 6},
+            "config": {
+                "dataset_config": {"type": "mnist", "num_clients": 4, "train_bs": 8},
+                "global_model": "mlp",
+                "rounds_per_dispatch": 3,
+                "evaluation_interval": 3,
+                "server_config": {"lr": 1.0},
+            },
+        }
+    }
+    [s] = run_experiments(experiments, storage_path=str(tmp_path), verbose=0)
+    assert s["rounds"] == 6
+    lines = (Path(s["dir"]) / "result.json").read_text().strip().splitlines()
+    assert len(lines) == 2  # two dispatches of 3 rounds
+    assert json.loads(lines[-1])["training_iteration"] == 6
