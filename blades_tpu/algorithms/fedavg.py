@@ -100,6 +100,7 @@ class Fedavg:
 
         self.timers = Timers()
         self._iteration = 0
+        self._rounds_since_eval = 0
         self._last_eval: Dict = {}
 
     def _attach_root_data(self, fed_round: FedRound) -> FedRound:
@@ -142,6 +143,7 @@ class Fedavg:
                 for k, v in metrics.items()
             }
         self._iteration += self._chunk
+        self._rounds_since_eval += self._chunk
         result = {
             "training_iteration": self._iteration,
             "train_loss": metrics["train_loss"],
@@ -149,9 +151,12 @@ class Fedavg:
             "update_norm_mean": metrics["update_norm_mean"],
             "timers": self.timers.summary(),
         }
+        # Rounds-since-last-eval cadence: robust to rounds_per_dispatch not
+        # dividing evaluation_interval (a modulo test would then never fire).
         if self.config.evaluation_interval and (
-            self._iteration % self.config.evaluation_interval == 0
+            self._rounds_since_eval >= self.config.evaluation_interval
         ):
+            self._rounds_since_eval = 0
             result.update(self.evaluate())
         elif self._last_eval:
             result.update(self._last_eval)
@@ -175,6 +180,7 @@ class Fedavg:
         path.mkdir(parents=True, exist_ok=True)
         payload = {
             "iteration": self._iteration,
+            "rounds_since_eval": self._rounds_since_eval,
             "key": jax.device_get(self._key),
             "state": jax.device_get(self.state),
             "config_dict": {k: v for k, v in self.config.items()
@@ -192,6 +198,7 @@ class Fedavg:
         with open(p, "rb") as f:
             payload = pickle.load(f)
         self._iteration = payload["iteration"]
+        self._rounds_since_eval = payload.get("rounds_since_eval", 0)
         self._key = jnp.asarray(payload["key"])
         state = jax.tree.map(jnp.asarray, payload["state"])
         if self.mesh is not None:

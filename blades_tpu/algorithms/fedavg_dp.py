@@ -18,8 +18,9 @@ from blades_tpu.algorithms.fedavg import Fedavg
 class FedavgDPConfig(FedavgConfig):
     def __init__(self, algo_class=None):
         super().__init__(algo_class or Fedavg)
-        # ref: fedavg_dp.yaml:42-44 canonical grid eps in {1, 10, 100}.
-        self.dp_epsilon: float = 10.0
+        # ref: fedavg_dp.py:16-18 defaults (the canonical YAML grid sweeps
+        # eps over {1, 10, 100}, ref: fedavg_dp.yaml:42-44).
+        self.dp_epsilon: float = 1.0
         self.dp_delta: float = 1e-6
         self.dp_clip_threshold: float = 1.0
 
@@ -29,10 +30,11 @@ class FedavgDPConfig(FedavgConfig):
 
     @property
     def noise_factor(self) -> float:
-        """(ref: fedavg_dp.py:40-45: sensitivity = clip / num_batch_per_round;
-        multiplier = sensitivity * sqrt(2 ln(1.25/delta)) / epsilon,
-        normalised by the clip so FedRound can scale by clip * factor.)"""
-        sensitivity = self.dp_clip_threshold / self.num_batch_per_round
+        """(ref: fedavg_dp.py:44-46: sensitivity = 2 * clip / train_bs;
+        sigma = sensitivity * sqrt(2 ln(1.25/delta)) / epsilon, ref: :23-27.
+        Returned normalised by the clip because FedRound scales by
+        clip * factor — the product is exactly the reference's sigma.)"""
+        sensitivity = 2.0 * self.dp_clip_threshold / self.train_batch_size
         sigma = sensitivity * math.sqrt(2.0 * math.log(1.25 / self.dp_delta)) / self.dp_epsilon
         return sigma / self.dp_clip_threshold
 

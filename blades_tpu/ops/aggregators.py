@@ -205,6 +205,14 @@ class Multikrum(Aggregator):
 
     Score of client i = sum of its ``n - f - 2`` smallest squared distances
     to other clients; aggregate = mean of the ``k`` lowest-scoring updates.
+
+    DELIBERATE divergence from the reference implementation: the reference
+    stores ``dist**2`` and then squares again inside ``_compute_scores``
+    (ref: multikrum.py:19-20, :87), effectively ranking by sums of
+    ``dist**4`` — a bug vs the Krum paper it cites.  The neighbour
+    *selection* is unaffected (x^2 is monotone on nonnegatives) but the
+    cross-client ranking, and hence the selected set, can differ.  This
+    implementation follows the paper's squared-distance score.
     """
 
     num_byzantine: int

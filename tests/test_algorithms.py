@@ -115,12 +115,14 @@ def test_registry():
 
 def test_dp_noise_factor_formula():
     cfg = FedavgDPConfig()
+    assert cfg.dp_epsilon == 1.0  # ref default, fedavg_dp.py:17
     cfg.dp_epsilon, cfg.dp_delta, cfg.dp_clip_threshold = 10.0, 1e-6, 1.0
-    cfg.num_batch_per_round = 1
-    # sigma = clip/1 * sqrt(2 ln(1.25e6)) / 10; factor = sigma / clip
+    cfg.train_batch_size = 32
+    # ref fedavg_dp.py:44-46: sensitivity = 2*clip/train_bs;
+    # sigma = sensitivity * sqrt(2 ln(1.25/delta)) / eps; factor = sigma/clip
     import math
 
-    expect = math.sqrt(2 * math.log(1.25 / 1e-6)) / 10.0
+    expect = (2.0 / 32.0) * math.sqrt(2 * math.log(1.25 / 1e-6)) / 10.0
     assert np.isclose(cfg.noise_factor, expect)
 
 
