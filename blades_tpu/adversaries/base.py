@@ -46,9 +46,11 @@ class Adversary:
     - ``grad_hook(grads, malicious) -> grads`` — runs after backward inside
       the train step (training-corruption attacks).
     - ``on_updates_ready(updates, malicious, key, *, aggregator,
-      global_params) -> updates`` — runs on the stacked update matrix before
-      aggregation (update-forging attacks, the omniscient-attacker model of
-      SURVEY.md §3.4).
+      global_params, shard) -> updates`` — runs on the stacked update
+      matrix before aggregation (update-forging attacks, the
+      omniscient-attacker model of SURVEY.md §3.4).  ``shard`` is a
+      :class:`~blades_tpu.ops.layout.ShardInfo` when ``updates`` is a
+      width shard ``(n, d_local)`` of the global matrix (None = dense).
     """
 
     def data_hook(self, x, y, malicious):
@@ -60,8 +62,8 @@ class Adversary:
         return grads
 
     def on_updates_ready(self, updates, malicious, key, *, aggregator=None,
-                         global_params=None):
-        del key, aggregator, global_params, malicious
+                         global_params=None, shard=None):
+        del key, aggregator, global_params, malicious, shard
         return updates
 
     @property

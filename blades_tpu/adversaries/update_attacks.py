@@ -4,6 +4,19 @@ Each attack reads benign statistics (the omniscient-attacker model,
 SURVEY.md §3.4) and scatters a forged row into the malicious lanes — all
 inside the round's jit program.  Where the reference uses torch's global
 RNG, these take an explicit key.
+
+Every hook is **layout-aware**: ``shard`` (a
+:class:`~blades_tpu.ops.layout.ShardInfo`) describes a width-sharded
+``(n, d_local)`` update matrix at giant-federation scale.  Global row
+geometry (norms, pairwise distances, sign censuses) is then computed as
+``psum`` of shard partials, and coordinate-position logic (e.g. the
+SignGuard-evasion "negate the first half") uses *global* coordinates —
+``shard=None`` means the dense ``(n, d)`` layout and reduces to local
+math.  Keyed draws: deterministic attacks match the dense path exactly
+(same key -> same forged row); :class:`NoiseAdversary` folds the shard
+index into its key (its (n, d) draw cannot be column-sliced from a dense
+draw), so its rows are i.i.d. per layout rather than bit-equal across
+layouts.
 """
 
 from __future__ import annotations
@@ -16,16 +29,24 @@ import jax.numpy as jnp
 from jax import lax
 
 from blades_tpu.adversaries.base import Adversary, benign_mean_std
+from blades_tpu.ops import layout as L
 from blades_tpu.ops.aggregators import Signguard
 
 
-def _negate_first_half(v: jax.Array) -> jax.Array:
+def _negate_first_half(v: jax.Array, shard=None) -> jax.Array:
     """SignGuard-evasion trick shared by ALIE and MinMax: negate the first
-    ``d // 2`` coordinates of the deviation (the reference's
+    ``d // 2`` *global* coordinates of the deviation (the reference's
     ``random.sample(range(d // 2), d // 2)`` enumerates *all* of the first
-    half, ref: alie_adversary.py:34-39, minmax_adversary.py:45-52)."""
-    d = v.shape[0]
-    return jnp.where(jnp.arange(d) < d // 2, -v, v)
+    half, ref: alie_adversary.py:34-39, minmax_adversary.py:45-52).
+
+    Under width sharding "first half" is a global notion: compare each
+    column's global coordinate against ``global_d // 2`` — negating the
+    local first half of every shard would be a different (wrong) attack.
+    """
+    if shard is None:
+        d = v.shape[0]
+        return jnp.where(jnp.arange(d) < d // 2, -v, v)
+    return jnp.where(shard.coords() < shard.global_d // 2, -v, v)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -49,11 +70,11 @@ class ALIEAdversary(Adversary):
         return NormalDist().inv_cdf(min(max(cdf, 1e-9), 1.0 - 1e-9))
 
     def on_updates_ready(self, updates, malicious, key, *, aggregator=None,
-                         global_params=None):
+                         global_params=None, shard=None):
         del key, global_params
         mean, std = benign_mean_std(updates, malicious)
         if isinstance(aggregator, Signguard):
-            std = _negate_first_half(std)
+            std = _negate_first_half(std, shard)
         forged = mean + std * self.z_max
         return self.scatter_forged(updates, forged, malicious)
 
@@ -61,13 +82,14 @@ class ALIEAdversary(Adversary):
 @dataclasses.dataclass(frozen=True)
 class IPMAdversary(Adversary):
     """Inner-product manipulation: forged = -scale * benign_mean
-    (ref: ipm_adversary.py:15-23).  Canonical scales 0.1 and 100."""
+    (ref: ipm_adversary.py:15-23).  Canonical scales 0.1 and 100.
+    Coordinate-wise, so width sharding needs no global terms."""
 
     scale: float = 1.0
 
     def on_updates_ready(self, updates, malicious, key, *, aggregator=None,
-                         global_params=None):
-        del key, aggregator, global_params
+                         global_params=None, shard=None):
+        del key, aggregator, global_params, shard
         mean, _ = benign_mean_std(updates, malicious)
         return self.scatter_forged(updates, -self.scale * mean, malicious)
 
@@ -75,16 +97,26 @@ class IPMAdversary(Adversary):
 @dataclasses.dataclass(frozen=True)
 class NoiseAdversary(Adversary):
     """Pure Gaussian noise rows N(mean, std), independent per malicious lane
-    (ref: noise_adversary.py:23-33)."""
+    (ref: noise_adversary.py:23-33).
+
+    Width-sharded: the key is folded with the shard index so coordinates
+    are i.i.d. across the full row (a replicated key would repeat the same
+    pattern every ``width`` coordinates); padding columns are zeroed so
+    psum'd row geometry seen by aggregators stays exact.
+    """
 
     mean: float = 0.1
     std: float = 0.1
 
     def on_updates_ready(self, updates, malicious, key, *, aggregator=None,
-                         global_params=None):
+                         global_params=None, shard=None):
         del aggregator, global_params
+        if shard is not None:
+            key = jax.random.fold_in(key, lax.axis_index(shard.axis))
         noise = self.mean + self.std * jax.random.normal(key, updates.shape,
                                                          updates.dtype)
+        if shard is not None:
+            noise = jnp.where(shard.valid()[None, :], noise, 0.0)
         return jnp.where(malicious[:, None], noise, updates)
 
 
@@ -96,27 +128,27 @@ class MinMaxAdversary(Adversary):
     ``mean - gamma * std`` sits no farther from any benign update than the
     max benign pairwise distance; ~9 bisection steps reach the reference's
     0.01 tolerance, run as a fixed-iteration ``fori_loop``.  SignGuard-aware
-    (negates the first half of the deviation, ref: :45-52).
+    (negates the first half of the deviation, ref: :45-52).  All distances
+    are global (psum'd) under width sharding.
     """
 
     iters: int = 12
 
     def on_updates_ready(self, updates, malicious, key, *, aggregator=None,
-                         global_params=None):
+                         global_params=None, shard=None):
         del key, global_params
         mean, dev = benign_mean_std(updates, malicious)
         if isinstance(aggregator, Signguard):
-            dev = _negate_first_half(dev)
+            dev = _negate_first_half(dev, shard)
         benign = ~malicious
         w = benign.astype(updates.dtype)
-        # Max pairwise distance among benign rows (masked).
-        sq = jnp.sum(updates**2, axis=1)
-        d2 = sq[:, None] + sq[None, :] - 2.0 * (updates @ updates.T)
+        # Max pairwise distance among benign rows (masked, global geometry).
+        d2 = L.pairwise_sq_dists(updates, shard)
         pair_ok = w[:, None] * w[None, :]
         threshold = jnp.sqrt(jnp.maximum((d2 * pair_ok).max(), 0.0))
 
         def max_dist_to_benign(forged):
-            dist = jnp.linalg.norm(updates - forged[None, :], axis=1)
+            dist = L.row_norms(updates - forged[None, :], shard)
             return jnp.where(benign, dist, -jnp.inf).max()
 
         def body(_, lohi):
@@ -139,12 +171,16 @@ class AdaptiveAdversary(Adversary):
     ``b = 2``: pick a random forged value just beyond the benign max (when
     s = -1) or just below the benign min (when s = +1), with the sign-aware
     interval endpoints of the reference's four masks.
+
+    Width-sharded: the per-coordinate uniform draw is made over the full
+    global width on every shard and column-sliced, so the forged row is
+    bit-identical to the dense layout's.
     """
 
     b: float = 2.0
 
     def on_updates_ready(self, updates, malicious, key, *, aggregator=None,
-                         global_params=None):
+                         global_params=None, shard=None):
         del aggregator, global_params
         mean, _ = benign_mean_std(updates, malicious)
         benign = (~malicious)[:, None]
@@ -152,7 +188,12 @@ class AdaptiveAdversary(Adversary):
         mn = jnp.where(benign, updates, jnp.inf).min(axis=0)
         s = jnp.sign(mean)
         b = self.b
-        r = jax.random.uniform(key, mean.shape, mean.dtype)
+        if shard is None:
+            r = jax.random.uniform(key, mean.shape, mean.dtype)
+        else:
+            r = L.slice_to_shard(
+                jax.random.uniform(key, (shard.global_d,), mean.dtype), shard
+            )
         # The four sign-cases of ref: adaptive_adversary.py:33-56.
         neg_pos = r * ((b - 1.0) * mx) + mx          # s=-1, max > 0
         neg_neg = r * ((1.0 / b - 1.0) * mx) + mx    # s=-1, max < 0
@@ -179,18 +220,34 @@ class SignGuardAdversary(Adversary):
     ranks below ``#pos`` become +U(0,1), the next ``#neg`` become -U(0,1),
     the rest 0 — the same distribution as the reference's
     ``hstack([rand(pos), -rand(neg), zeros(z)])[perm]``.
+
+    Width-sharded: the sign census is psum'd (exact global counts), and the
+    rank permutation + magnitudes are drawn over the full global width on
+    every shard and column-sliced — bit-identical to the dense layout.
+    Padding columns receive rank ``d_pad`` (>= #pos + #neg), hence 0.
     """
 
     def on_updates_ready(self, updates, malicious, key, *, aggregator=None,
-                         global_params=None):
+                         global_params=None, shard=None):
         del aggregator, global_params
         mean, _ = benign_mean_std(updates, malicious)
-        d = mean.shape[0]
         k_perm, k_mag = jax.random.split(key)
-        pos = (mean > 0).sum()
-        neg = (mean < 0).sum()
-        rank = jax.random.permutation(k_perm, d)
-        u = jax.random.uniform(k_mag, (d,), mean.dtype)
+        if shard is None:
+            d = mean.shape[0]
+            pos = (mean > 0).sum()
+            neg = (mean < 0).sum()
+            rank = jax.random.permutation(k_perm, d)
+            u = jax.random.uniform(k_mag, (d,), mean.dtype)
+        else:
+            valid = shard.valid()
+            pos = shard.psum((mean > 0).sum())
+            neg = shard.psum((mean < 0).sum())
+            d = shard.global_d
+            rank = L.slice_to_shard(jax.random.permutation(k_perm, d), shard)
+            # slice_to_shard zero-pads; remap padding columns to rank d_pad
+            # so they land in the "zeros" tail of the census.
+            rank = jnp.where(valid, rank, shard.d_pad)
+            u = L.slice_to_shard(jax.random.uniform(k_mag, (d,), mean.dtype), shard)
         forged = jnp.where(rank < pos, u, jnp.where(rank < pos + neg, -u, 0.0))
         return self.scatter_forged(updates, forged, malicious)
 
@@ -206,22 +263,25 @@ class AttackclippedclusteringAdversary(Adversary):
     cluster member with max angle ``theta`` to the benign mean.  Forge
     ``10 * (a * mean_hat + b * u*_hat)`` rotating past the cluster gap, or
     ``-10 * mean`` if the chained angle exceeds pi (ref: :80-96).
+
+    Width-sharded: row norms, the cosine matrix, and the mean-angle dots
+    are psum'd global geometry; the clustering runs replicated (identical
+    on every shard); the forged row's local columns come from local slices
+    of ``mean_hat`` / ``u*``.
     """
 
     eps: float = 1e-4
 
     def on_updates_ready(self, updates, malicious, key, *, aggregator=None,
-                         global_params=None):
+                         global_params=None, shard=None):
         del key, aggregator, global_params
         from blades_tpu.ops import clustering as C
 
         benign = ~malicious
         w = benign.astype(updates.dtype)
         mean, _ = benign_mean_std(updates, malicious)
-        normed = updates / jnp.maximum(
-            jnp.linalg.norm(updates, axis=1, keepdims=True), 1e-12
-        )
-        cos = jnp.clip(normed @ normed.T, -1.0, 1.0)
+        normed = updates / jnp.maximum(L.row_norms(updates, shard), 1e-12)[:, None]
+        cos = jnp.clip(L.gram(normed, shard), -1.0, 1.0)
         dist = 1.0 - cos
         n = updates.shape[0]
         eye = jnp.eye(n, dtype=bool)
@@ -234,8 +294,9 @@ class AttackclippedclusteringAdversary(Adversary):
         big_dist = jnp.where(pair_ok | eye, dist, 2.0)
         majority = C.agglomerative_majority(big_dist, linkage="single") & benign
 
-        mean_hat = mean / jnp.maximum(jnp.linalg.norm(mean), 1e-12)
-        cos2mean = normed @ mean_hat
+        mean_norm = jnp.sqrt(jnp.maximum(L.row_sq_norms(mean[None, :], shard)[0], 0.0))
+        mean_hat = mean / jnp.maximum(mean_norm, 1e-12)
+        cos2mean = L.row_dots(normed, mean_hat, shard)
         dis2mean = jnp.where(majority, 1.0 - cos2mean, -jnp.inf)
         idx = jnp.argmax(dis2mean)
         theta = jnp.arccos(jnp.clip(1.0 - dis2mean[idx], -1.0, 1.0))

@@ -155,19 +155,27 @@ class Server:
                     "row would silently become the root of trust"
                 )
             updates = jnp.concatenate([updates, trusted_update[None, :]], axis=0)
-        ravel, unravel, _ = ravel_fn(state.params)
         agg, agg_state = self.aggregator(updates, state.agg_state, key=key)
+        return self.apply_aggregate(state, agg, agg_state), agg
+
+    def apply_aggregate(
+        self, state: ServerState, agg: jax.Array, agg_state: Any = None
+    ) -> ServerState:
+        """The optimizer half of :meth:`step`: descend on ``-agg``.
+
+        Factored out so the d-sharded round (which aggregates on width
+        shards and gathers only the final ``(d,)`` vector) applies the
+        IDENTICAL momentum/schedule/weight-decay update as the dense path.
+        """
+        ravel, unravel, _ = ravel_fn(state.params)
         grads = unravel(-agg)
         opt_updates, opt_state = self.optimizer().update(
             grads, state.opt_state, state.params
         )
         params = optax.apply_updates(state.params, opt_updates)
-        return (
-            ServerState(
-                params=params,
-                opt_state=opt_state,
-                agg_state=agg_state,
-                round=state.round + 1,
-            ),
-            agg,
+        return ServerState(
+            params=params,
+            opt_state=opt_state,
+            agg_state=state.agg_state if agg_state is None else agg_state,
+            round=state.round + 1,
         )

@@ -1,0 +1,123 @@
+"""Width-shard layout context for d-sharded update matrices.
+
+At giant-federation scale the ``(n, d)`` update matrix lives width-sharded:
+each device holds ``(n, d_local)`` where ``d_local = d_pad / n_shards`` and
+``d_pad`` zero-pads ``d`` to a multiple of the shard count (see
+:mod:`blades_tpu.parallel.dsharded`).  Aggregators and update-forging
+adversaries that need *global* row geometry (norms, pairwise distances,
+coordinate positions) receive a :class:`ShardInfo` describing the layout
+and compute exact global quantities via ``psum`` of shard partials —
+without this context, attacks like ALIE's SignGuard-evasion (which negates
+the *global* first half of the coordinate axis) would silently operate on
+local shard geometry (the round-1 landmine: adversaries/update_attacks.py
+``_negate_first_half`` applied per-shard).
+
+Everything here degrades to the dense layout: ``shard=None`` means "the
+rows are full-width", and the helpers reduce to plain local math.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardInfo:
+    """Static description of a width-sharded ``(n, d_local)`` layout.
+
+    Attributes:
+        axis: mesh axis name the width is sharded over (``psum`` target).
+        num_shards: number of width shards (= mesh size along ``axis``).
+        global_d: the TRUE (unpadded) global width.
+        width: local shard width ``= d_pad / num_shards`` where
+            ``d_pad = num_shards * width >= global_d``; coordinates at
+            global positions ``>= global_d`` are zero padding.
+    """
+
+    axis: str
+    num_shards: int
+    global_d: int
+    width: int
+
+    @property
+    def d_pad(self) -> int:
+        return self.num_shards * self.width
+
+    def offset(self) -> jax.Array:
+        """This device's first global coordinate (traced, device-dependent)."""
+        return lax.axis_index(self.axis) * self.width
+
+    def coords(self) -> jax.Array:
+        """Global coordinate index of each local column ``(width,)``."""
+        return self.offset() + jnp.arange(self.width)
+
+    def valid(self) -> jax.Array:
+        """Mask of local columns that are real (not padding) ``(width,)``."""
+        return self.coords() < self.global_d
+
+    def psum(self, x: jax.Array) -> jax.Array:
+        return lax.psum(x, self.axis)
+
+
+def psum_if(x: jax.Array, shard: Optional[ShardInfo]) -> jax.Array:
+    """``psum`` a shard-partial reduction, or pass through when dense."""
+    return x if shard is None else shard.psum(x)
+
+
+def row_sq_norms(rows: jax.Array, shard: Optional[ShardInfo] = None) -> jax.Array:
+    """Global squared L2 norm of each row ``(n,)`` from ``(n, w)`` shards."""
+    return psum_if(jnp.sum(rows**2, axis=-1), shard)
+
+
+def row_norms(rows: jax.Array, shard: Optional[ShardInfo] = None) -> jax.Array:
+    return jnp.sqrt(jnp.maximum(row_sq_norms(rows, shard), 0.0))
+
+
+def row_dots(rows: jax.Array, v: jax.Array, shard: Optional[ShardInfo] = None) -> jax.Array:
+    """Global ``rows @ v`` ``(n,)`` from ``(n, w)`` / ``(w,)`` shards."""
+    return psum_if(rows @ v, shard)
+
+
+def gram(rows: jax.Array, shard: Optional[ShardInfo] = None) -> jax.Array:
+    """Global Gram matrix ``rows @ rows.T`` ``(n, n)`` from shards."""
+    return psum_if(rows @ rows.T, shard)
+
+
+def pairwise_sq_dists(rows: jax.Array, shard: Optional[ShardInfo] = None) -> jax.Array:
+    """Exact global ``(n, n)`` pairwise squared distances from shards.
+
+    ``||x_i - x_j||^2 = sum_shards(partial)`` — each partial term is linear
+    in per-shard sums, so one ``psum`` of the assembled partial is exact
+    (up to float reassociation across shards).
+    """
+    sq = jnp.sum(rows**2, axis=1)
+    g = rows @ rows.T
+    partial = sq[:, None] + sq[None, :] - 2.0 * g
+    return psum_if(partial, shard)
+
+
+def clip_rows_to_norm(
+    rows: jax.Array,
+    max_norm: jax.Array,
+    shard: Optional[ShardInfo] = None,
+    eps: float = 1e-12,
+) -> jax.Array:
+    """Row-norm clipping with globally-correct norms under width sharding."""
+    norms = row_norms(rows, shard)[:, None]
+    scale = jnp.minimum(1.0, max_norm / jnp.maximum(norms, eps))
+    return rows * scale
+
+
+def slice_to_shard(v: jax.Array, shard: ShardInfo) -> jax.Array:
+    """Slice a replicated global ``(global_d,)`` vector to the local window.
+
+    Pads with zeros to ``d_pad`` first, so the last shard's window is
+    in-bounds and its padding coordinates read 0.
+    """
+    v = jnp.pad(v, (0, shard.d_pad - v.shape[0]))
+    return lax.dynamic_slice(v, (shard.offset(),), (shard.width,))

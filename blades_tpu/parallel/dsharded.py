@@ -11,96 +11,122 @@ DeepSpeed-Ulysses' sequence<->head re-shard, done here over ICI with
 ``(n_local, d)``; one all-to-all turns that into all clients' rows on a
 width shard ``(n, d_local)``.  Per-device memory stays ``n*d/n_dev``.
 
-On the ``(n, d_local)`` layout:
+On the ``(n, d_local)`` layout every aggregator in the suite is exact:
 
-- **coordinate-wise aggregators** (Mean, Median, Trimmedmean) are exact —
-  they never mix coordinates; aggregate the shard, keep the result
-  d-sharded for the server step (no gather of the full vector needed).
-- **row-geometry aggregators** (Multikrum, GeoMed, Centeredclipping, and
-  the norm/cosine filters) need cross-coordinate reductions; those are
-  computed as ``psum`` of shard-partial Gram/norm terms — see
-  :func:`psum_pairwise_sq_dists` — so the geometry is exact too, without
-  ever materialising ``(n, d)`` anywhere.
+- **coordinate-wise** (Mean, Median, Trimmedmean) — they never mix
+  coordinates; aggregate the shard directly.
+- **row-geometry** (Multikrum, GeoMed, MinMax-style distances, FLTrust
+  cosines) — cross-coordinate reductions are ``psum``s of shard-partial
+  Gram/norm terms (:mod:`blades_tpu.ops.layout`), so the geometry is
+  exact without ever materialising ``(n, d)`` anywhere.
+- **stateful** (Centeredclipping's ``(d,)`` momentum, Clippedclustering's
+  norm history) — state stays replicated exactly as on the dense path
+  (a ``(d,)`` vector is small; it is the ``(n, d)`` *matrix* that must
+  never exist), sliced to the local window for compute.
+- **spectral** (DnC) — only the ``sub_dim`` *sampled* columns are
+  assembled (psum of locally-owned columns), an ``(n, sub_dim)`` matrix
+  with ``sub_dim << d``; the SVD runs replicated.
 
-This module provides the d-sharded round for the aggregators the giant
-scale actually uses (the reference's CIFAR grids lean on
-median/trimmed-mean/Krum); exotic stateful aggregators keep the gather
-path at small n.
+The server optimizer step is the IDENTICAL replicated
+momentum/schedule/weight-decay program as the dense path
+(:meth:`~blades_tpu.core.server.Server.apply_aggregate`): only the final
+``(d,)`` aggregate is all-gathered.  Update-forging adversaries receive a
+:class:`~blades_tpu.ops.layout.ShardInfo` and compute their global
+geometry the same psum'd way (see
+:mod:`blades_tpu.adversaries.update_attacks`).
 """
 
 from __future__ import annotations
 
 from functools import partial
-from typing import Callable
+from typing import Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-import optax
 from jax import lax, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from blades_tpu.core.round import FedRound, RoundState
 from blades_tpu.data.sampler import sample_client_batches
-from blades_tpu.ops import masked
+from blades_tpu.ops import clustering, layout as L, masked
 from blades_tpu.ops.aggregators import (
+    Centeredclipping,
+    Clippedclustering,
+    DnC,
+    FLTrust,
     GeoMed,
     Mean,
     Median,
     Multikrum,
+    Signguard,
     Trimmedmean,
 )
 from blades_tpu.parallel.mesh import CLIENTS_AXIS
-from blades_tpu.utils.tree import ravel_fn
 
 AXIS = CLIENTS_AXIS
 
 
-def psum_pairwise_sq_dists(rows_shard: jax.Array, axis: str = AXIS) -> jax.Array:
-    """Exact (n, n) pairwise squared distances from d-sharded rows.
+def _sign_census_majority(clipped: jax.Array, shard: L.ShardInfo) -> jax.Array:
+    """SignGuard's k-means majority over psum'd global sign fractions.
 
-    ``rows_shard`` is ``(n, d_local)``; partial Gram terms are psum'd over
-    the width shards: ||x_i - x_j||^2 = sum_shards(partial).
+    Matches :func:`blades_tpu.ops.clustering.sign_features` on the dense
+    matrix: padding columns are zero, so global ``#zero`` is exactly
+    ``global_d - #pos - #neg``.
     """
-    sq = jnp.sum(rows_shard**2, axis=1)
-    gram = rows_shard @ rows_shard.T
-    partial_d2 = sq[:, None] + sq[None, :] - 2.0 * gram
-    return lax.psum(partial_d2, axis)
+    d = shard.global_d
+    pos = shard.psum((clipped > 0).sum(axis=1))
+    neg = shard.psum((clipped < 0).sum(axis=1))
+    zero = d - pos - neg
+    feats = (
+        jnp.stack([pos, neg, zero], axis=1).astype(clipped.dtype) / d
+    )
+    return clustering.kmeans_majority(feats)
 
 
-def _aggregate_dshard(aggregator, upd_shard: jax.Array, axis: str = AXIS) -> jax.Array:
+def _aggregate_dshard(
+    aggregator,
+    upd_shard: jax.Array,
+    shard: L.ShardInfo,
+    *,
+    key: Optional[jax.Array] = None,
+    agg_state=(),
+    trusted_shard: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, object]:
     """Aggregate an ``(n, d_local)`` shard -> ``(d_local,)``, exactly.
 
-    Coordinate-wise aggregators apply directly; Multikrum/GeoMed use
-    psum'd global geometry to select/weight rows, then reduce the local
-    width shard.
+    Returns ``(aggregate_shard, new_agg_state)`` — the same contract as
+    ``Aggregator.__call__`` on the dense matrix, with global geometry
+    recovered via psum.  State layout is identical to the dense path's
+    (replicated), so checkpoints are interchangeable between paths.
     """
-    if isinstance(aggregator, (Mean,)):
-        return upd_shard.mean(axis=0)
+    n = upd_shard.shape[0]
+    if isinstance(aggregator, Mean):
+        return upd_shard.mean(axis=0), agg_state
     if isinstance(aggregator, Median):
-        return masked.median(upd_shard)
+        return masked.median(upd_shard), agg_state
     if isinstance(aggregator, Trimmedmean):
-        n = upd_shard.shape[0]
         k = aggregator.num_excluded
         if n <= 2 * k:
             raise ValueError(f"Trimmedmean needs > {2*k} clients, got {n}")
         s = jnp.sort(upd_shard, axis=0)
-        return s[k : n - k].mean(axis=0)
+        return s[k : n - k].mean(axis=0), agg_state
     if isinstance(aggregator, Multikrum):
-        n = upd_shard.shape[0]
         f = aggregator.num_byzantine
-        d2 = psum_pairwise_sq_dists(upd_shard, axis)
+        if 2 * f + 2 > n:
+            raise ValueError(f"Too many Byzantine workers: 2*{f}+2 > {n}")
+        if not (1 <= aggregator.k <= n):
+            raise ValueError(f"k must be in [1, {n}], got {aggregator.k}")
+        d2 = L.pairwise_sq_dists(upd_shard, shard)
         d2 = jnp.maximum(d2, 0.0)
         d2 = jnp.where(jnp.eye(n, dtype=bool), jnp.inf, d2)
         nearest = jnp.sort(d2, axis=1)[:, : n - f - 2]
         rank = jnp.argsort(jnp.argsort(nearest.sum(axis=1)))
-        return masked.masked_mean(upd_shard, rank < aggregator.k)
+        return masked.masked_mean(upd_shard, rank < aggregator.k), agg_state
     if isinstance(aggregator, GeoMed):
-        n = upd_shard.shape[0]
         weights = jnp.ones((n,), upd_shard.dtype) / n
 
         def dists(median_shard):
-            partial = jnp.sum((upd_shard - median_shard[None, :]) ** 2, axis=1)
-            return jnp.sqrt(jnp.maximum(lax.psum(partial, axis), 1e-24))
+            return L.row_norms(upd_shard - median_shard[None, :], shard)
 
         def wavg(w):
             return (w[:, None] * upd_shard).sum(axis=0) / w.sum()
@@ -111,47 +137,138 @@ def _aggregate_dshard(aggregator, upd_shard: jax.Array, axis: str = AXIS) -> jax
             dn = jnp.maximum(dists(m), aggregator.eps)
             return wavg(weights / dn)
 
-        return lax.fori_loop(0, aggregator.maxiter, body, median)
+        return lax.fori_loop(0, aggregator.maxiter, body, median), agg_state
+    if isinstance(aggregator, DnC):
+        if key is None:
+            raise ValueError("DnC requires a PRNG key (see ops/aggregators.py)")
+        d = shard.global_d
+        sub_dim = min(aggregator.sub_dim, d)
+        keep = n - int(aggregator.filter_frac * aggregator.num_byzantine)
+        if keep < 1:
+            raise ValueError(
+                f"DnC keeps {keep} clients; needs >= 1 (n={n}, "
+                f"f={aggregator.num_byzantine})"
+            )
+        offset = shard.offset()
+        benign = jnp.zeros((n,), dtype=bool)
+        # Assemble only the SAMPLED columns: each shard contributes the
+        # columns it owns, one psum makes the (n, sub_dim) matrix global.
+        for k_iter in jax.random.split(key, aggregator.num_iters):
+            idx = jax.random.permutation(k_iter, d)[:sub_dim]
+            local_pos = idx - offset
+            owned = (local_pos >= 0) & (local_pos < shard.width)
+            cols = jnp.take(
+                upd_shard, jnp.clip(local_pos, 0, shard.width - 1), axis=1
+            )
+            sub = shard.psum(jnp.where(owned[None, :], cols, 0.0))
+            mu = sub.mean(axis=0)
+            centered = sub - mu
+            v = jnp.linalg.svd(centered, full_matrices=False)[2][0]
+            s = (centered @ v) ** 2
+            rank = jnp.argsort(jnp.argsort(s))
+            benign = benign | (rank < keep)
+        return masked.masked_mean(upd_shard, benign), agg_state
+    if isinstance(aggregator, FLTrust):
+        if trusted_shard is None:
+            raise ValueError(
+                "FLTrust requires the server's trusted root-data update "
+                "(FedRound.trusted_data)"
+            )
+        s_norm = jnp.sqrt(jnp.maximum(shard.psum((trusted_shard**2).sum()), 0.0))
+        c_norm = jnp.maximum(L.row_norms(upd_shard, shard), 1e-12)
+        cos = L.row_dots(upd_shard, trusted_shard, shard) / (
+            c_norm * jnp.maximum(s_norm, 1e-12)
+        )
+        trust = jax.nn.relu(cos)
+        rescaled = upd_shard * (s_norm / c_norm)[:, None]
+        agg = (trust[:, None] * rescaled).sum(axis=0) / jnp.maximum(
+            trust.sum(), 1e-12
+        )
+        return agg, agg_state
+    if isinstance(aggregator, Centeredclipping):
+        momentum = agg_state
+        if momentum is None or (isinstance(momentum, tuple) and not momentum):
+            momentum = jnp.zeros((shard.global_d,), upd_shard.dtype)
+        mom_local = L.slice_to_shard(momentum, shard)
+
+        def body(_, center):
+            dev = L.clip_rows_to_norm(
+                upd_shard - center[None, :], aggregator.tau, shard
+            )
+            return center + dev.mean(axis=0)
+
+        mom_local = lax.fori_loop(0, aggregator.n_iter, body, mom_local)
+        new_momentum = lax.all_gather(mom_local, shard.axis, axis=0, tiled=True)[
+            : shard.global_d
+        ]
+        return mom_local, new_momentum
+    if isinstance(aggregator, Signguard):
+        norms = L.row_norms(upd_shard, shard)
+        M = jnp.median(norms)
+        clipped = upd_shard * jnp.minimum(
+            1.0, M / jnp.maximum(norms, 1e-12)
+        )[:, None]
+        cnorms = jnp.minimum(norms, M)
+        s1 = (cnorms >= 0.1 * M) & (cnorms <= 3.0 * M)
+        s2 = _sign_census_majority(clipped, shard)
+        mask = s1 & s2
+        if aggregator.agg == "mean":
+            return masked.masked_mean(clipped, mask), agg_state
+        return masked.masked_median(clipped, mask), agg_state
+    if isinstance(aggregator, Clippedclustering):
+        norms = L.row_norms(upd_shard, shard)
+        state = agg_state
+        if state is None or (isinstance(state, tuple) and not state):
+            state = aggregator.init(shard.global_d, n)
+        hist, count = state["norm_history"], state["count"]
+        cap = hist.shape[0]
+        pos = (count + jnp.arange(n)) % cap
+        hist = hist.at[pos].set(norms.astype(hist.dtype))
+        count = count + n
+        filled = jnp.arange(cap) < jnp.minimum(count, cap)
+        threshold = masked.masked_median(hist[:, None], filled)[0]
+        threshold = jnp.minimum(threshold, aggregator.max_tau)
+        clipped = upd_shard * jnp.minimum(
+            1.0, threshold / jnp.maximum(norms, 1e-12)
+        )[:, None]
+        cl_norms = jnp.minimum(norms, threshold)
+        normed = clipped / jnp.maximum(cl_norms, 1e-12)[:, None]
+        cos = jnp.clip(L.gram(normed, shard), -1.0, 1.0)
+        dist = 1.0 - cos
+        # Zero-norm rows -> max distance 2 (ref: clippedclustering.py:49-51).
+        zero = cl_norms < 1e-12
+        bad = zero[:, None] | zero[None, :]
+        dist = jnp.where(bad, 2.0, dist)
+        mask = clustering.agglomerative_majority(dist, linkage=aggregator.linkage)
+        if aggregator.signguard:
+            mask = mask & _sign_census_majority(clipped, shard)
+        if aggregator.agg == "mean":
+            agg = masked.masked_mean(clipped, mask)
+        else:
+            agg = masked.masked_median(clipped, mask)
+        return agg, {"norm_history": hist, "count": count}
     raise NotImplementedError(
-        f"{type(aggregator).__name__} has no d-sharded formulation; use the "
-        "all_gather path (shard_map_step) at small n"
+        f"{type(aggregator).__name__} has no d-sharded formulation"
     )
 
 
 def dsharded_step(fr: FedRound, mesh: Mesh) -> Callable:
     """The giant-federation round: local training on client shards, ONE
-    all-to-all to width shards, exact aggregation, d-sharded server step,
-    and an all-gather of only the final (d,) parameter delta.
+    all-to-all to width shards, exact aggregation, and an all-gather of
+    only the final ``(d,)`` aggregate into the replicated server step.
 
-    Same signature as :func:`~blades_tpu.parallel.sharded.sharded_step`.
-    Constraints: ``n`` divisible by mesh size; flat parameter dimension is
-    zero-padded to a multiple of the mesh size; plain-SGD server (the
-    d-sharded optimizer step is elementwise).
+    Same signature and semantics as
+    :func:`~blades_tpu.parallel.sharded.shard_map_step` — all ten
+    aggregators, all update-forging adversaries, and the full server
+    optimizer (momentum/schedule/weight-decay) are supported; results
+    match the gather path up to float reassociation of the psum'd
+    geometry (keyed noise draws excepted, see
+    :class:`~blades_tpu.adversaries.update_attacks.NoiseAdversary`).
+    Constraint: ``n`` divisible by the mesh size.
     """
-    from blades_tpu.adversaries.update_attacks import (
-        AttackclippedclusteringAdversary,
-        MinMaxAdversary,
-        SignGuardAdversary,
-    )
-
     adv_forges = fr.adversary is not None and hasattr(
         fr.adversary, "on_updates_ready"
     )
-    if isinstance(
-        fr.adversary,
-        (MinMaxAdversary, SignGuardAdversary, AttackclippedclusteringAdversary),
-    ):
-        raise NotImplementedError(
-            f"{type(fr.adversary).__name__} needs full-row geometry; its "
-            "forgery is not coordinate-wise and would be computed per width "
-            "shard — use shard_map_step/sharded_step at a scale where the "
-            "(n, d) gather fits"
-        )
-    if fr.server.momentum or fr.server.schedule or fr.server.weight_decay:
-        raise NotImplementedError(
-            "dsharded_step implements the elementwise plain-SGD server step "
-            "only (momentum/schedule/weight_decay state is not d-sharded yet)"
-        )
     n_dev = mesh.devices.size
     state_spec = RoundState(server=P(), client_opt=P(AXIS))
     data_spec = P(AXIS)
@@ -192,11 +309,13 @@ def dsharded_step(fr: FedRound, mesh: Mesh) -> Callable:
         # (n_local, d_pad) --all_to_all--> (n, d_pad / n_dev).
         d = upd_local.shape[1]
         d_pad = -(-d // n_dev) * n_dev
+        width = d_pad // n_dev
+        shard = L.ShardInfo(axis=AXIS, num_shards=n_dev, global_d=d, width=width)
         upd_local = jnp.pad(upd_local, ((0, 0), (0, d_pad - d)))
         upd_shard = lax.all_to_all(
-            upd_local.reshape(n_local, n_dev, d_pad // n_dev),
+            upd_local.reshape(n_local, n_dev, width),
             AXIS, split_axis=1, concat_axis=0, tiled=False,
-        ).reshape(n_local * n_dev, d_pad // n_dev)
+        ).reshape(n_local * n_dev, width)
 
         mal_all = lax.all_gather(malicious, AXIS, axis=0, tiled=True)
         losses = lax.all_gather(losses_local, AXIS, axis=0, tiled=True)
@@ -206,35 +325,35 @@ def dsharded_step(fr: FedRound, mesh: Mesh) -> Callable:
                 upd_shard, mal_all, k_adv,
                 aggregator=fr.server.aggregator,
                 global_params=state.server.params,
+                shard=shard,
             )
 
-        agg_shard = _aggregate_dshard(fr.server.aggregator, upd_shard)
-
-        # d-sharded plain-SGD server step, then gather only the (d,) delta.
-        ravel, unravel, _ = ravel_fn(state.server.params)
-        flat = jnp.pad(ravel(state.server.params), (0, d_pad - d))
-        shard_ix = lax.axis_index(AXIS)
-        w = d_pad // n_dev
-        flat_shard = lax.dynamic_slice(flat, (shard_ix * w,), (w,))
-        lr = fr.server.lr
-        new_flat_shard = flat_shard + lr * agg_shard
-        new_flat = lax.all_gather(new_flat_shard, AXIS, axis=0, tiled=True)[:d]
-        params = unravel(new_flat)
-
-        from blades_tpu.core.server import ServerState
-
-        server = ServerState(
-            params=params,
-            opt_state=state.server.opt_state,
-            agg_state=state.server.agg_state,
-            round=state.server.round + 1,
+        # FLTrust's trusted row: the server's own local round on root data,
+        # computed replicated (identical on every device), window-sliced.
+        trusted = fr.compute_trusted_update(
+            state.server.params, jax.random.fold_in(k_agg, 1)
         )
+        trusted_shard = (
+            L.slice_to_shard(trusted, shard) if trusted is not None else None
+        )
+
+        agg_shard, agg_state = _aggregate_dshard(
+            fr.server.aggregator, upd_shard, shard,
+            key=k_agg, agg_state=state.server.agg_state,
+            trusted_shard=trusted_shard,
+        )
+
+        # Gather only the (d,) aggregate; the optimizer step is the same
+        # replicated program as the dense path (momentum/schedule/decay).
+        agg = lax.all_gather(agg_shard, AXIS, axis=0, tiled=True)[:d]
+        server = fr.server.apply_aggregate(state.server, agg, agg_state)
+
         benign = (~mal_all).astype(jnp.float32)
         train_loss = (losses * benign).sum() / jnp.maximum(benign.sum(), 1.0)
-        agg_norm = jnp.sqrt(lax.psum(jnp.sum(agg_shard**2), AXIS))
         metrics = {
             "train_loss": train_loss,
-            "agg_norm": agg_norm,
+            "update_norm_mean": L.row_norms(upd_shard, shard).mean(),
+            "agg_norm": jnp.linalg.norm(agg),
             "round": server.round,
         }
         return RoundState(server=server, client_opt=client_opt), metrics
