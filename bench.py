@@ -1,80 +1,159 @@
-"""Benchmark: FL rounds/sec, FedAvg + ALIE + Median on CIFAR-10/ResNet-18.
+"""Benchmark: FL rounds/sec at the 1000-client north-star scale.
 
-The BASELINE.json headline workload scaled to the available chip: N clients
-run vmapped local SGD on ResNet-18 (bf16 compute, f32 master params), ALIE
-forges the Byzantine lanes, the server aggregates with coordinate-wise
-Median.  Rounds are fused ``CHUNK`` at a time into one XLA dispatch
-(``FedRound.multi_step``).  Metric = full FL rounds/sec (local train +
-attack + robust aggregate + server step, all on device).
+Workload (BASELINE.json headline, scaled to the chip actually present):
+1000 clients run vmapped local SGD on CIFAR-10 shapes, ALIE forges the
+Byzantine quarter, the server aggregates with coordinate-wise Median —
+one full FL round = local train + attack + robust aggregate + server
+step, all on device, via the single-chip streaming round
+(:mod:`blades_tpu.parallel.streamed`): bf16 update matrix, client-block
+``lax.map`` training, d-chunked forge+aggregate.
 
-``vs_baseline`` compares against the reference envelope: the Ray/GPU
-reference at its canonical 60-client CIFAR-10/ResNet config is bounded by
-per-round Python/actor overhead at ~1 round/sec on a single GPU (SURVEY.md
-§6: 2000 rounds is a multi-hour budget); the north-star asks >=10x.  We
-report measured rounds/sec divided by that 1.0 round/sec envelope.
+Model: ResNet-10 — the reference's canonical CIFAR-10 model
+(``global_model: resnet`` -> ``ResNet10()``, ref:
+blades/tuned_examples/fedavg_cifar10_resnet_noniid.yaml:16 +
+fllib/models/catalog.py:20-21).  The north star also names ResNet-18; at
+n=1000 its bf16 update matrix is 22.3 GB and CANNOT exist on one 16 GB
+v5e chip — that configuration is the multi-chip d-sharded path
+(``parallel/dsharded.py``, validated on the 8-device mesh by
+tests/test_dsharded.py and the driver's dryrun), sized for the v5e-8 the
+north star specifies.  ResNet-10 at n=1000 (9.8 GB) is the largest
+faithful single-chip instance.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Honest reporting (VERDICT r1):
+- ``value`` is measured rounds/sec with a concrete fetch from the final
+  output (``block_until_ready`` returns early through the axon relay).
+- ``mfu`` uses XLA's own compiled-program FLOP count when available,
+  otherwise an analytic per-sample estimate, against v5e bf16 peak.
+- ``vs_baseline`` divides by an ESTIMATED reference throughput — the
+  reference publishes no throughput numbers (BASELINE.md) and Ray is not
+  installable in this image, so the denominator is derived from the
+  reference's own envelope: ~1 round/s at 60 clients on one GPU
+  (SURVEY.md §6: 2000 rounds = multi-hour budget), scaled by 1000/60
+  clients with PERFECT 4-GPU scaling (its "large" preset) ->
+  0.24 rounds/s.  The estimate and its provenance ride in the JSON.
+
+Prints ONE JSON line.
 """
 
 from __future__ import annotations
 
 import json
 import time
-from functools import partial
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-NUM_CLIENTS = 64
-NUM_BYZANTINE = 12
+NUM_CLIENTS = 1000
+NUM_BYZANTINE = 250
 BATCH = 32
-SHARD = 64
-CHUNK = 10  # rounds fused per dispatch
-NUM_CHUNKS = 3
-BASELINE_ROUNDS_PER_SEC = 1.0
+SHARD = 32
+LOCAL_STEPS = 1          # ref: algorithm_config.py:63 default
+CLIENT_BLOCK = 50
+D_CHUNK = 1 << 17
+WARMUP = 1
+TIMED_ROUNDS = 5
+
+# Estimated reference throughput at n=1000 (see module docstring).
+BASELINE_EST_ROUNDS_PER_SEC = 0.24
+V5E_BF16_PEAK_FLOPS = 197e12
 
 
 def main() -> None:
     from blades_tpu.adversaries import get_adversary, make_malicious_mask
     from blades_tpu.core import FedRound, Server, TaskSpec
+    from blades_tpu.parallel.streamed import streamed_step
 
-    task = TaskSpec(model="resnet18", input_shape=(32, 32, 3), num_classes=10,
+    task = TaskSpec(model="resnet10", input_shape=(32, 32, 3), num_classes=10,
                     lr=0.1, compute_dtype="bfloat16").build()
     server = Server.from_config(aggregator="Median", lr=0.5)
-    adv = get_adversary("ALIE", num_clients=NUM_CLIENTS, num_byzantine=NUM_BYZANTINE)
+    adv = get_adversary("ALIE", num_clients=NUM_CLIENTS,
+                        num_byzantine=NUM_BYZANTINE)
     fr = FedRound(task=task, server=server, adversary=adv, batch_size=BATCH,
-                  num_batches_per_round=1)
+                  num_batches_per_round=LOCAL_STEPS)
 
     rng = np.random.default_rng(0)
-    x = jnp.asarray(rng.normal(size=(NUM_CLIENTS, SHARD, 32, 32, 3)), jnp.float32)
+    x = jnp.asarray(rng.normal(size=(NUM_CLIENTS, SHARD, 32, 32, 3)),
+                    jnp.float32)
     y = jnp.asarray(rng.integers(0, 10, size=(NUM_CLIENTS, SHARD)), jnp.int32)
     lengths = jnp.full((NUM_CLIENTS,), SHARD, jnp.int32)
     mal = make_malicious_mask(NUM_CLIENTS, NUM_BYZANTINE)
 
     state = fr.init(jax.random.PRNGKey(0), NUM_CLIENTS)
-    step = jax.jit(partial(fr.multi_step, num_rounds=CHUNK), donate_argnums=(0,))
+    step = streamed_step(fr, client_block=CLIENT_BLOCK, d_chunk=D_CHUNK)
+
+    d = sum(p.size for p in jax.tree.leaves(state.server.params))
+
+    # XLA's own FLOP count for one client's local round; the round is
+    # n_clients of those plus the (bandwidth-bound) aggregation.
+    flops_per_round, flops_src = None, "xla_cost_analysis"
+    try:
+        opt0 = fr.task.init_client_opt_state(state.server.params)
+        bx = jnp.zeros((LOCAL_STEPS, BATCH, 32, 32, 3), jnp.float32)
+        by = jnp.zeros((LOCAL_STEPS, BATCH), jnp.int32)
+
+        def one_client(params, opt, bx, by, key):
+            return fr.task.local_round(params, opt, bx, by, key,
+                                       jnp.array(False))
+
+        cost = (
+            jax.jit(one_client)
+            .lower(state.server.params, opt0, bx, by, jax.random.PRNGKey(0))
+            .compile()
+            .cost_analysis()
+        )
+        if cost and cost.get("flops"):
+            flops_per_round = NUM_CLIENTS * float(cost["flops"])
+    except Exception:
+        pass
+    if not flops_per_round:
+        # Analytic: fwd+bwd ~= 3x fwd; ResNet-10 @32x32 ~= 0.5 GFLOP fwd
+        # -> 1.5 GFLOP per sample.
+        flops_per_round = NUM_CLIENTS * BATCH * LOCAL_STEPS * 1.5e9
+        flops_src = "analytic_estimate"
 
     # Warmup / compile.
-    state, m = step(state, x, y, lengths, mal, jax.random.PRNGKey(1))
-    _ = float(m["train_loss"][-1])
+    for r in range(WARMUP):
+        state, m = step(state, x, y, lengths, mal,
+                        jax.random.fold_in(jax.random.PRNGKey(1), r))
+    _ = float(m["train_loss"])
 
     t0 = time.perf_counter()
-    for c in range(NUM_CHUNKS):
+    for r in range(TIMED_ROUNDS):
         state, metrics = step(state, x, y, lengths, mal,
-                              jax.random.fold_in(jax.random.PRNGKey(2), c))
+                              jax.random.fold_in(jax.random.PRNGKey(2), r))
     # Fetch a concrete value from the final round: forces the whole chain.
     # (block_until_ready alone returns early through the axon tunnel.)
-    final_loss = float(metrics["train_loss"][-1])
+    final_loss = float(metrics["train_loss"])
     assert final_loss == final_loss  # NaN guard
     dt = time.perf_counter() - t0
 
-    rounds_per_sec = (CHUNK * NUM_CHUNKS) / dt
+    rounds_per_sec = TIMED_ROUNDS / dt
+    mfu = rounds_per_sec * flops_per_round / V5E_BF16_PEAK_FLOPS
     print(json.dumps({
-        "metric": "fl_rounds_per_sec_fedavg_alie_median_cifar10_resnet18_64clients",
+        "metric": "fl_rounds_per_sec_1000clients_fedavg_alie_median_cifar10_resnet10",
         "value": round(rounds_per_sec, 3),
         "unit": "rounds/s",
-        "vs_baseline": round(rounds_per_sec / BASELINE_ROUNDS_PER_SEC, 3),
+        "vs_baseline": round(rounds_per_sec / BASELINE_EST_ROUNDS_PER_SEC, 2),
+        "baseline": {
+            "rounds_per_sec": BASELINE_EST_ROUNDS_PER_SEC,
+            "kind": "estimate",
+            "provenance": "reference publishes no throughput; ~1 round/s "
+                          "@60 clients/1 GPU envelope x (1000/60 clients) "
+                          "/ 4 GPUs perfect scaling",
+        },
+        "mfu": round(mfu, 4),
+        "flops_per_round": flops_per_round,
+        "flops_source": flops_src,
+        "config": {
+            "clients": NUM_CLIENTS, "byzantine": NUM_BYZANTINE,
+            "model": "resnet10", "params": d, "batch": BATCH,
+            "local_steps": LOCAL_STEPS, "update_matrix": "bf16",
+            "path": "streamed_single_chip",
+            "note": "resnet18@1000 (22.3 GB bf16) exceeds one 16 GB chip; "
+                    "that config runs d-sharded on a mesh "
+                    "(parallel/dsharded.py)",
+        },
     }))
 
 

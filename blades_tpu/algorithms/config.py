@@ -20,7 +20,9 @@ _INPUT_SHAPES = {
     "mnist": (28, 28, 1),
     "fashionmnist": (28, 28, 1),
     "cifar10": (32, 32, 3),
+    "cifar100": (32, 32, 3),
 }
+_NUM_CLASSES = {"mnist": 10, "fashionmnist": 10, "cifar10": 10, "cifar100": 100}
 
 
 class FedavgConfig:
@@ -45,6 +47,12 @@ class FedavgConfig:
         self.client_momentum: float = 0.0
         self.num_batch_per_round: int = 1  # ref: algorithm_config.py:63
         self.train_batch_size: int = 32
+        # benign grad-norm clipping callback (ref: blades/clients/
+        # callbacks.py:10-15); None disables
+        self.clip_gradient_norm: Optional[float] = None
+        # generic client callback chain: list of {"type": ...} specs
+        # (ref: fllib/clients/callbacks.py ClientCallbackList)
+        self.client_callbacks: Optional[list] = None
         # server (ref: server_config.py)
         self.aggregator: Any = {"type": "Mean"}
         self.server_lr: float = 0.1
@@ -102,8 +110,11 @@ class FedavgConfig:
             train_batch_size=train_batch_size,
         )
 
-    def client(self, *, lr=None, momentum=None):
-        return self._set(client_lr=lr, client_momentum=momentum)
+    def client(self, *, lr=None, momentum=None, clip_gradient_norm=None,
+               callbacks=None):
+        return self._set(client_lr=lr, client_momentum=momentum,
+                         clip_gradient_norm=clip_gradient_norm,
+                         client_callbacks=callbacks)
 
     def adversary(self, *, num_malicious_clients=None, adversary_config=None):
         return self._set(num_malicious_clients=num_malicious_clients,
@@ -145,7 +156,9 @@ class FedavgConfig:
                                "train_bs": "train_batch_size",
                                "num_classes": "num_classes", "seed": "seed"},
             "client_config": {"lr": "client_lr", "momentum": "client_momentum",
-                              "num_batch_per_round": "num_batch_per_round"},
+                              "num_batch_per_round": "num_batch_per_round",
+                              "clip_gradient_norm": "clip_gradient_norm",
+                              "callbacks": "client_callbacks"},
             "server_config": {"lr": "server_lr", "momentum": "server_momentum",
                               "dampening": "server_dampening",
                               "weight_decay": "server_weight_decay",
@@ -181,16 +194,21 @@ class FedavgConfig:
             )
         if self.num_malicious_clients > 0 and not self.adversary_config:
             raise ValueError("num_malicious_clients > 0 requires adversary_config")
+        name = self.dataset if isinstance(self.dataset, str) else getattr(
+            self.dataset, "name", None)
+        name = name.lower() if isinstance(name, str) else None
         if self.input_shape is None:
-            name = self.dataset if isinstance(self.dataset, str) else getattr(
-                self.dataset, "name", None)
-            if isinstance(name, str) and name.lower() in _INPUT_SHAPES:
-                self.input_shape = _INPUT_SHAPES[name.lower()]
+            if name in _INPUT_SHAPES:
+                self.input_shape = _INPUT_SHAPES[name]
             else:
                 raise ValueError(
                     "input_shape could not be inferred; set "
                     ".training(input_shape=...)"
                 )
+        # A known dataset with a non-10-class label space overrides the
+        # default num_classes (a 10-way head on CIFAR-100 is never right).
+        if name in _NUM_CLASSES and self.num_classes == 10:
+            self.num_classes = _NUM_CLASSES[name]
 
     def freeze(self) -> None:
         self._frozen = True
@@ -206,7 +224,7 @@ class FedavgConfig:
         augment = self.augment
         if augment == "auto":
             name = self.dataset if isinstance(self.dataset, str) else ""
-            augment = "cifar" if str(name).lower() == "cifar10" else None
+            augment = "cifar" if str(name).lower() in ("cifar10", "cifar100") else None
         return TaskSpec(
             model=self.global_model, num_classes=self.num_classes,
             input_shape=tuple(self.input_shape), lr=self.client_lr,
@@ -232,6 +250,14 @@ class FedavgConfig:
             num_classes=self.num_classes,
         )
 
+    def get_client_callbacks(self) -> tuple:
+        from blades_tpu.core.callbacks import ClippingCallback, get_callback
+
+        cbs = [get_callback(s) for s in (self.client_callbacks or [])]
+        if self.clip_gradient_norm:
+            cbs.append(ClippingCallback(float(self.clip_gradient_norm)))
+        return tuple(cbs)
+
     def get_fed_round(self) -> FedRound:
         return FedRound(
             task=self.get_task_spec().build(),
@@ -241,6 +267,10 @@ class FedavgConfig:
             num_batches_per_round=self.num_batch_per_round,
             dp_clip_threshold=self.dp_clip_threshold,
             dp_noise_factor=self.dp_noise_factor,
+            client_callbacks=self.get_client_callbacks(),
+            # True federation size: ghost lanes from mesh padding (see
+            # shard_federation) are sliced out of forging/aggregation.
+            num_clients=self.num_clients,
         )
 
     def build(self):

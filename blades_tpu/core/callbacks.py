@@ -1,0 +1,113 @@
+"""Client callbacks: the reference's hook chain as pure per-lane functions.
+
+The reference threads a ``ClientCallbackList`` through local training with
+four hook points — ``on_train_round_begin``, ``on_train_batch_begin``,
+``on_backward_end``, ``on_train_round_end`` (ref: fllib/clients/
+callbacks.py:25-56) — mutating the client/task in place.  The TPU-native
+form: each hook is a pure function over the lane's values, vmapped with a
+``malicious`` flag so one jit program serves every client
+(SURVEY.md §7.3).  Chains compose by folding.
+
+The concrete reference callbacks map as:
+
+- benign gradient clipping (ref: blades/clients/callbacks.py:10-15
+  ``ClippingCallback.on_backward_end`` -> :class:`ClippingCallback` here,
+  configured from ``client_config: {clip_gradient_norm: ...}``);
+- DP clip+noise (ref: blades/clients/dp_client.py:32-43) stays on the
+  stacked update matrix (:meth:`~blades_tpu.core.round.FedRound.apply_dp`)
+  where the row view is free;
+- adversary training attacks (label-flip, sign-flip) remain the
+  adversary's ``data_hook``/``grad_hook`` and compose AFTER these
+  callbacks — the reference appends the attack callback to the client's
+  list (ref: blades/clients/client.py:98-102), i.e. it also runs last.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ClientCallback:
+    """Base callback: all four hooks are identity (pure, per-lane)."""
+
+    def on_round_begin(self, params, opt_state, malicious):
+        del malicious
+        return params, opt_state
+
+    def on_batch_begin(self, x, y, malicious):
+        del malicious
+        return x, y
+
+    def on_backward_end(self, grads, malicious):
+        del malicious
+        return grads
+
+    def on_round_end(self, update, malicious):
+        del malicious
+        return update
+
+
+@dataclasses.dataclass(frozen=True)
+class CallbackChain(ClientCallback):
+    """Folds a tuple of callbacks in order (ref: ClientCallbackList,
+    fllib/clients/callbacks.py:59-101)."""
+
+    callbacks: Tuple[ClientCallback, ...] = ()
+
+    def on_round_begin(self, params, opt_state, malicious):
+        for cb in self.callbacks:
+            params, opt_state = cb.on_round_begin(params, opt_state, malicious)
+        return params, opt_state
+
+    def on_batch_begin(self, x, y, malicious):
+        for cb in self.callbacks:
+            x, y = cb.on_batch_begin(x, y, malicious)
+        return x, y
+
+    def on_backward_end(self, grads, malicious):
+        for cb in self.callbacks:
+            grads = cb.on_backward_end(grads, malicious)
+        return grads
+
+    def on_round_end(self, update, malicious):
+        for cb in self.callbacks:
+            update = cb.on_round_end(update, malicious)
+        return update
+
+
+@dataclasses.dataclass(frozen=True)
+class ClippingCallback(ClientCallback):
+    """Benign gradient clipping after backward (ref: blades/clients/
+    callbacks.py:10-15): scale the whole grad pytree so its GLOBAL L2 norm
+    is at most ``clip_threshold`` — torch ``clip_grad_norm_`` semantics.
+    Applies to every lane (the reference attaches it to all clients)."""
+
+    clip_threshold: float = 1.0
+
+    def on_backward_end(self, grads, malicious):
+        del malicious
+        sq = sum(jnp.sum(g**2) for g in jax.tree.leaves(grads))
+        norm = jnp.sqrt(jnp.maximum(sq, 0.0))
+        scale = jnp.minimum(1.0, self.clip_threshold / jnp.maximum(norm, 1e-12))
+        return jax.tree.map(lambda g: g * scale, grads)
+
+
+CALLBACKS = {"Clipping": ClippingCallback}
+
+
+def get_callback(spec) -> ClientCallback:
+    """Resolve ``{"type": ..., **kwargs}`` / name / instance -> callback."""
+    if isinstance(spec, ClientCallback):
+        return spec
+    if isinstance(spec, str):
+        spec = {"type": spec}
+    spec = dict(spec)
+    name = spec.pop("type")
+    if name not in CALLBACKS:
+        raise KeyError(f"unknown callback {name!r}; known: {sorted(CALLBACKS)}")
+    return CALLBACKS[name](**spec)

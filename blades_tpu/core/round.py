@@ -17,14 +17,24 @@ gather becomes an ICI collective.
 from __future__ import annotations
 
 import dataclasses
+from collections import namedtuple
 from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
+from blades_tpu.core.callbacks import CallbackChain
 from blades_tpu.core.server import Server, ServerState
-from blades_tpu.core.task import Task, identity_data_hook, identity_grad_hook
+from blades_tpu.core.task import (
+    Task,
+    identity_data_hook,
+    identity_grad_hook,
+    identity_round_begin_hook,
+    identity_round_end_hook,
+)
 from blades_tpu.data.sampler import sample_client_batches
+
+Hooks = namedtuple("Hooks", ["data", "grad", "round_begin", "round_end"])
 
 
 @dataclasses.dataclass
@@ -51,6 +61,12 @@ class FedRound:
     adversary: Any = None  # duck-typed: data_hook/grad_hook/on_updates_ready
     batch_size: int = 32
     num_batches_per_round: int = 1  # ref: algorithm_config.py:63 default 1
+    # True federation size.  When the client axis is zero-padded to a mesh
+    # multiple (see parallel/mesh.py shard_federation), lanes >= num_clients
+    # are ghosts: they run the (harmless) local round for shape regularity
+    # but are statically sliced away before forging, aggregation and
+    # metrics.  None means "every lane is real".
+    num_clients: Optional[int] = None
     # Differential privacy on client updates (ref: blades/clients/
     # dp_client.py:32-43): clip each update row to dp_clip_threshold, add
     # N(0, (noise_factor * clip)^2) noise.  None disables.
@@ -60,6 +76,11 @@ class FedRound:
     # (FLTrust): each round the server trains its own local round on this
     # clean data and the result becomes the trusted reference row.
     trusted_data: Optional[Tuple[jax.Array, jax.Array]] = None
+    # Client callback chain (ref: fllib/clients/callbacks.py): tuple of
+    # blades_tpu.core.callbacks.ClientCallback, applied to EVERY lane,
+    # composing BEFORE the adversary's hooks (the reference appends the
+    # attack callback last).
+    client_callbacks: Tuple = ()
 
     # -- construction -------------------------------------------------------
 
@@ -75,13 +96,29 @@ class FedRound:
 
     # -- hooks --------------------------------------------------------------
 
-    def _hooks(self):
-        if self.adversary is None:
-            return identity_data_hook, identity_grad_hook
-        return (
-            getattr(self.adversary, "data_hook", identity_data_hook),
-            getattr(self.adversary, "grad_hook", identity_grad_hook),
+    def _hooks(self) -> Hooks:
+        """Compose the client callback chain with the adversary's hooks."""
+        adv_data = (
+            getattr(self.adversary, "data_hook", identity_data_hook)
+            if self.adversary is not None else identity_data_hook
         )
+        adv_grad = (
+            getattr(self.adversary, "grad_hook", identity_grad_hook)
+            if self.adversary is not None else identity_grad_hook
+        )
+        if not self.client_callbacks:
+            return Hooks(adv_data, adv_grad,
+                         identity_round_begin_hook, identity_round_end_hook)
+        chain = CallbackChain(tuple(self.client_callbacks))
+
+        def data(x, y, malicious):
+            x, y = chain.on_batch_begin(x, y, malicious)
+            return adv_data(x, y, malicious)
+
+        def grad(grads, malicious):
+            return adv_grad(chain.on_backward_end(grads, malicious), malicious)
+
+        return Hooks(data, grad, chain.on_round_begin, chain.on_round_end)
 
     # -- the round ----------------------------------------------------------
 
@@ -107,18 +144,21 @@ class FedRound:
         bx, by = sample_client_batches(
             k_sample, data_x, data_y, lengths, self.batch_size, self.num_batches_per_round
         )
-        data_hook, grad_hook = self._hooks()
+        hooks = self._hooks()
         client_keys = jax.random.split(k_train, num_clients)
 
         def one_client(opt_state, cbx, cby, ck, mal):
             return self.task.local_round(
-                state.server.params, opt_state, cbx, cby, ck, mal,
-                data_hook, grad_hook,
+                state.server.params, opt_state, cbx, cby, ck, mal, *hooks
             )
 
         updates, client_opt, losses = jax.vmap(one_client)(
             state.client_opt, bx, by, client_keys, malicious
         )
+        # Drop ghost (padding) lanes before anything consumes the matrix.
+        k = self.num_clients
+        if k is not None and k < updates.shape[0]:
+            updates, losses, malicious = updates[:k], losses[:k], malicious[:k]
         updates = self.apply_dp(updates, k_dp)
 
         if self.adversary is not None and hasattr(self.adversary, "on_updates_ready"):

@@ -50,6 +50,16 @@ def identity_grad_hook(grads, malicious):
     return grads
 
 
+def identity_round_begin_hook(params, opt_state, malicious):
+    del malicious
+    return params, opt_state
+
+
+def identity_round_end_hook(update, malicious):
+    del malicious
+    return update
+
+
 @dataclasses.dataclass(frozen=True)
 class TaskSpec:
     """Declarative task config (ref: fllib/tasks/task.py:32-71)."""
@@ -153,6 +163,8 @@ class Task:
         malicious,
         data_hook: DataHook = identity_data_hook,
         grad_hook: GradHook = identity_grad_hook,
+        round_begin_hook=identity_round_begin_hook,
+        round_end_hook=identity_round_end_hook,
     ):
         """One client's full local round: scan SGD over ``num_batches``.
 
@@ -162,6 +174,12 @@ class Task:
             batches_x/batches_y: ``(num_batches, batch, ...)`` presampled.
             key: per-client PRNG key (dropout etc.).
             malicious: scalar bool — this lane's malicious flag.
+            data_hook/grad_hook: per-batch hooks (callback chain +
+                adversary, ref: fllib/clients/callbacks.py:33-48).
+            round_begin_hook/round_end_hook: round-boundary hooks (ref:
+                callbacks.py:25-31, :50-56); ``round_end`` edits the flat
+                pseudo-gradient the way the reference's
+                ``on_train_round_end`` edits ``pseudo_grad_vec``.
 
         Returns:
             ``(update_vec, new_opt_state, mean_loss)`` where ``update_vec`` is
@@ -170,6 +188,7 @@ class Task:
         ravel, _, _ = ravel_fn(global_params)
         num_batches = batches_x.shape[0]
         keys = jax.random.split(key, num_batches)
+        params0, opt_state = round_begin_hook(global_params, opt_state, malicious)
 
         def step(carry, inp):
             params, opt_state = carry
@@ -180,9 +199,12 @@ class Task:
             return (params, opt_state), loss
 
         (params, opt_state), losses = jax.lax.scan(
-            step, (global_params, opt_state), (batches_x, batches_y, keys)
+            step, (params0, opt_state), (batches_x, batches_y, keys)
         )
+        # Pseudo-grad is always vs the INCOMING global params (the
+        # reference snapshots the global weights, ref: task.py:159-168).
         update = ravel(params) - ravel(global_params)
+        update = round_end_hook(update, malicious)
         return update, opt_state, losses.mean()
 
     def evaluate(self, params, x, y, mask):

@@ -119,6 +119,27 @@ def _load_cifar10() -> Optional[Tuple[np.ndarray, ...]]:
     return tx, ty, vx, vy
 
 
+def _load_cifar100() -> Optional[Tuple[np.ndarray, ...]]:
+    """CIFAR-100 python pickles (``cifar-100-python/{train,test}`` with
+    ``fine_labels``).  Not in the reference's catalog, but named by the
+    benchmark targets (BASELINE.json config 5: CIFAR-100/ResNet-34)."""
+    root = data_root() / "cifar100" / "cifar-100-python"
+    if not root.exists():
+        root = data_root() / "cifar-100-python"
+    if not root.exists():
+        return None
+
+    def read_split(p: Path):
+        with open(p, "rb") as f:
+            d = pickle.load(f, encoding="bytes")
+        x = d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        return x, np.array(d[b"fine_labels"], np.int32)
+
+    tx, ty = read_split(root / "train")
+    vx, vy = read_split(root / "test")
+    return tx, ty, vx, vy
+
+
 def _synthetic_classification(
     n_train: int,
     n_test: int,
@@ -154,6 +175,8 @@ MNIST_MEAN, MNIST_STD = 0.1307, 0.3081
 FMNIST_MEAN, FMNIST_STD = 0.286, 0.353
 CIFAR_MEAN = np.array([0.4914, 0.4822, 0.4465], np.float32)
 CIFAR_STD = np.array([0.2023, 0.1994, 0.2010], np.float32)
+CIFAR100_MEAN = np.array([0.5071, 0.4865, 0.4409], np.float32)
+CIFAR100_STD = np.array([0.2673, 0.2564, 0.2762], np.float32)
 
 
 def _norm_gray(x: np.ndarray, mean: float, std: float) -> np.ndarray:
@@ -232,6 +255,17 @@ def build_cifar10(num_clients=60, iid=True, alpha=0.1, seed=0, **kw) -> FLDatase
     )
 
 
+def build_cifar100(num_clients=60, iid=True, alpha=0.1, seed=0, **kw) -> FLDataset:
+    def norm(x):
+        return ((x.astype(np.float32) / 255.0) - CIFAR100_MEAN) / CIFAR100_STD
+
+    return _build_image_dataset(
+        "cifar100", _load_cifar100, norm,
+        (32, 32, 3), 100, num_clients, iid, alpha, seed,
+        kw.get("train_frac", 1.0), 5000, 1000,
+    )
+
+
 def _load_mnist_like_factory(subdir: str):
     return lambda: _load_mnist_like(subdir)
 
@@ -244,6 +278,7 @@ _REGISTRY: Dict[str, Callable[..., FLDataset]] = {
     "mnist": build_mnist,
     "fashionmnist": build_fashionmnist,
     "cifar10": build_cifar10,
+    "cifar100": build_cifar100,
 }
 
 

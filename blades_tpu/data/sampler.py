@@ -33,6 +33,29 @@ def sample_batch(
     return x[idx], y[idx]
 
 
+def sample_client_batches_with_keys(
+    client_keys: jax.Array,
+    x: jax.Array,
+    y: jax.Array,
+    lengths: jax.Array,
+    batch_size: int,
+    num_batches: int,
+):
+    """As :func:`sample_client_batches` but with the per-client keys
+    pre-split — so a client-block streaming round (parallel/streamed.py)
+    can draw byte-identical batches for a block of lanes."""
+
+    def per_client(k, cx, cy, ln):
+        batch_keys = jax.random.split(k, num_batches)
+
+        def per_batch(kb):
+            return sample_batch(kb, cx, cy, ln, batch_size)
+
+        return jax.vmap(per_batch)(batch_keys)
+
+    return jax.vmap(per_client)(client_keys, x, y, lengths)
+
+
 def sample_client_batches(
     key: jax.Array,
     x: jax.Array,
@@ -47,15 +70,7 @@ def sample_client_batches(
     ``(num_clients, num_batches, batch_size, ...)``.  Each client gets an
     independent key fold so lanes are decorrelated.
     """
-    num_clients = x.shape[0]
-    client_keys = jax.random.split(key, num_clients)
-
-    def per_client(k, cx, cy, ln):
-        batch_keys = jax.random.split(k, num_batches)
-
-        def per_batch(kb):
-            return sample_batch(kb, cx, cy, ln, batch_size)
-
-        return jax.vmap(per_batch)(batch_keys)
-
-    return jax.vmap(per_client)(client_keys, x, y, lengths)
+    client_keys = jax.random.split(key, x.shape[0])
+    return sample_client_batches_with_keys(
+        client_keys, x, y, lengths, batch_size, num_batches
+    )

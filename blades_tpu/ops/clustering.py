@@ -77,19 +77,117 @@ def kmeans_majority(features: jax.Array, num_iters: int = 10) -> jax.Array:
     return jnp.where(majority_is_one, in_one, ~in_one)
 
 
-@partial(jax.jit, static_argnames=("linkage",))
-def agglomerative_majority(dist: jax.Array, linkage: str = "average") -> jax.Array:
+def _mst_single_linkage_majority(dist: jax.Array) -> jax.Array:
+    """Exact single-linkage 2-clustering in O(n^2): Prim's MST, cut the
+    heaviest edge, membership by pointer doubling over parent links.
+
+    Single-linkage agglomerative clustering stopped at 2 clusters is
+    EXACTLY "remove the largest edge of the minimum spanning tree" — the
+    classic equivalence that replaces the O(n^3) Lance-Williams merge loop
+    at giant-federation scale (n=1000 clients).
+    """
+    n = dist.shape[0]
+    idx = jnp.arange(n)
+    big = jnp.asarray(jnp.inf, dist.dtype)
+    eye = jnp.eye(n, dtype=bool)
+    D = jnp.where(eye, big, dist)
+
+    def body(_, carry):
+        in_tree, mindist, minsrc, parent, edge_w = carry
+        md = jnp.where(in_tree, big, mindist)
+        v = jnp.argmin(md)
+        parent = parent.at[v].set(minsrc[v])
+        edge_w = edge_w.at[v].set(md[v])
+        in_tree = in_tree.at[v].set(True)
+        better = D[v] < mindist
+        minsrc = jnp.where(better, v, minsrc)
+        mindist = jnp.minimum(mindist, D[v])
+        return in_tree, mindist, minsrc, parent, edge_w
+
+    in_tree = idx == 0
+    carry = (in_tree, D[0], jnp.zeros((n,), jnp.int32),
+             jnp.zeros((n,), jnp.int32), jnp.zeros((n,), dist.dtype))
+    _, _, _, parent, edge_w = lax.fori_loop(0, n - 1, body, carry)
+
+    # Cut the heaviest MST edge (edge_w[0] = 0: the root has no edge);
+    # cluster 1 = the subtree hanging below it.
+    v_star = jnp.argmax(edge_w)
+    member = idx == v_star
+    anc = parent
+    for _ in range(max(1, (n - 1).bit_length())):
+        member = member | member[anc]
+        anc = anc[anc]
+    n_one = member.sum()
+    # Larger cluster wins; ties go to the cluster of point 0 (the root,
+    # never in the cut subtree) — same rule as the merge-loop version.
+    take1 = n_one > n - n_one
+    return jnp.where(take1, member, ~member)
+
+
+def _spectral_bipartition_majority(dist: jax.Array, num_iters: int = 100) -> jax.Array:
+    """Normalized spectral 2-partition of a distance matrix in O(n^2 * iters).
+
+    Similarity ``S = 2 - dist`` (cosine distances live in [0, 2]); the
+    Fiedler direction — the second-largest eigenvector of
+    ``D^-1/2 S D^-1/2`` — is found by power iteration with the known top
+    eigenvector ``sqrt(deg)`` deflated out; points split by sign.  The
+    scalable stand-in for average-linkage 2-clustering at giant n, where
+    the exact Lance-Williams loop's O(n^3) merge chain is intractable
+    inside one XLA program.
+    """
+    n = dist.shape[0]
+    S = jnp.maximum(2.0 - dist, 0.0)
+    deg = S.sum(axis=1)
+    dinv = 1.0 / jnp.sqrt(jnp.maximum(deg, 1e-12))
+    u1 = jnp.sqrt(jnp.maximum(deg, 0.0))
+    u1 = u1 / jnp.maximum(jnp.linalg.norm(u1), 1e-12)
+
+    # Deterministic, aperiodic init; deflate u1 to stay in its complement.
+    x = jnp.cos(jnp.arange(n, dtype=dist.dtype) * 0.7) + 0.1
+    x = x - (u1 @ x) * u1
+
+    def body(_, x):
+        y = dinv * (S @ (dinv * x))
+        y = y - (u1 @ y) * u1
+        return y / jnp.maximum(jnp.linalg.norm(y), 1e-12)
+
+    x = lax.fori_loop(0, num_iters, body, x)
+    in_one = x > 0
+    n_one = in_one.sum()
+    majority_is_one = jnp.where(2 * n_one == n, in_one[0], n_one > n - n_one)
+    return jnp.where(majority_is_one, in_one, ~in_one)
+
+
+@partial(jax.jit, static_argnames=("linkage", "exact_threshold"))
+def agglomerative_majority(
+    dist: jax.Array, linkage: str = "average", exact_threshold: int = 128
+) -> jax.Array:
     """2-cluster agglomerative clustering on a precomputed distance matrix.
 
-    ``dist`` is a symmetric (n, n) matrix.  Merges the closest pair n-2
-    times using Lance-Williams updates (average: size-weighted mean of
-    cluster-to-cluster distances; single: min), then returns the boolean
-    mask of points in the larger of the two remaining clusters (ties go to
-    the cluster containing point 0).
+    ``dist`` is a symmetric (n, n) matrix; returns the boolean mask of
+    points in the larger of the two clusters (ties go to the cluster
+    containing point 0).
+
+    Scaling strategy (VERDICT r1 #8 — the merge loop is O(n^3) and cannot
+    reach n=1000):
+
+    - ``single`` linkage: exact at every n via the MST formulation
+      (:func:`_mst_single_linkage_majority`, O(n^2)).
+    - ``average`` linkage: the exact Lance-Williams merge loop up to
+      ``exact_threshold`` points (covers the reference's canonical 60-
+      client envelope with exact reference semantics), spectral
+      bipartition (:func:`_spectral_bipartition_majority`, O(n^2 *
+      iters)) beyond it — a documented approximation: both split along
+      the dominant cosine-geometry gap, which is what the
+      clipped-clustering defense consumes.
     """
     if linkage not in ("average", "single"):
         raise ValueError(f"unsupported linkage: {linkage}")
     n = dist.shape[0]
+    if linkage == "single":
+        return _mst_single_linkage_majority(dist)
+    if n > exact_threshold:
+        return _spectral_bipartition_majority(dist)
     big = jnp.asarray(jnp.inf, dist.dtype)
     eye = jnp.eye(n, dtype=bool)
     D = jnp.where(eye, big, dist)
@@ -103,10 +201,9 @@ def agglomerative_majority(dist: jax.Array, linkage: str = "average") -> jax.Arr
         r, c = flat // n, flat % n
         a, b = jnp.minimum(r, c), jnp.maximum(r, c)
         sa, sb = sizes[a], sizes[b]
-        if linkage == "average":
-            new_row = (sa * D[a] + sb * D[b]) / (sa + sb)
-        else:
-            new_row = jnp.minimum(D[a], D[b])
+        # Lance-Williams average-linkage update (single linkage never
+        # reaches this loop — it takes the MST path above).
+        new_row = (sa * D[a] + sb * D[b]) / (sa + sb)
         # Keep +inf against self and inactive clusters.
         idx = jnp.arange(n)
         dead = (~active) | (idx == a) | (idx == b)

@@ -24,6 +24,9 @@ program:
   width-shards so the full ``(n, d)`` never materialises on any device
   (the 1000-client x 11M-param memory wall, SURVEY.md §7.3); row geometry
   is recovered exactly via ``psum`` of shard-partial Gram terms.
+- :func:`streamed_step` — the single-chip fallback for the same memory
+  wall: bf16 update matrix, client-block ``lax.map`` training, d-chunked
+  forge+aggregate (coordinate-wise suite only).
 
 Multi-host (DCN) attaches via :func:`init_distributed`.
 """
@@ -37,3 +40,4 @@ from blades_tpu.parallel.mesh import (  # noqa: F401
 )
 from blades_tpu.parallel.dsharded import dsharded_step  # noqa: F401
 from blades_tpu.parallel.sharded import shard_map_step, sharded_step  # noqa: F401
+from blades_tpu.parallel.streamed import streamed_step  # noqa: F401

@@ -289,13 +289,12 @@ def dsharded_step(fr: FedRound, mesh: Mesh) -> Callable:
         bx, by = sample_client_batches(
             k_sample, data_x, data_y, lengths, fr.batch_size, fr.num_batches_per_round
         )
-        data_hook, grad_hook = fr._hooks()
+        hooks = fr._hooks()
         client_keys = jax.random.split(k_train, n_local)
 
         def one_client(opt_state, cbx, cby, ck, mal):
             return fr.task.local_round(
-                state.server.params, opt_state, cbx, cby, ck, mal,
-                data_hook, grad_hook,
+                state.server.params, opt_state, cbx, cby, ck, mal, *hooks
             )
 
         upd_local, client_opt, losses_local = jax.vmap(one_client)(
@@ -319,6 +318,10 @@ def dsharded_step(fr: FedRound, mesh: Mesh) -> Callable:
 
         mal_all = lax.all_gather(malicious, AXIS, axis=0, tiled=True)
         losses = lax.all_gather(losses_local, AXIS, axis=0, tiled=True)
+        # Drop ghost (padding) lanes — see FedRound.num_clients.
+        k = fr.num_clients
+        if k is not None and k < upd_shard.shape[0]:
+            upd_shard, mal_all, losses = upd_shard[:k], mal_all[:k], losses[:k]
 
         if adv_forges:
             upd_shard = fr.adversary.on_updates_ready(

@@ -80,20 +80,40 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def pad_to_multiple(array, multiple: int):
+    """Zero-pad an array's leading (client) axis up to a multiple."""
+    n = array.shape[0]
+    pad = (-n) % multiple
+    if pad == 0:
+        return array
+    widths = [(0, pad)] + [(0, 0)] * (array.ndim - 1)
+    return jax.numpy.pad(array, widths)
+
+
 def shard_federation(mesh: Mesh, round_state, data_arrays: Sequence[Any]):
     """Place a :class:`~blades_tpu.core.RoundState` + client data onto the mesh.
 
     Server state replicates; everything client-stacked shards on its leading
-    axis.  Client counts must divide the mesh size (pad the federation to a
-    multiple of the device count — the analogue of the reference requiring
-    ``num_clients`` divisible over workers).
+    axis.  Client counts that do not divide the mesh size are zero-padded to
+    the next multiple (the analogue of the reference scattering uneven
+    client sets over workers): padded lanes have empty shards
+    (``lengths = 0``), benign masks, and zeroed optimizer state, and the
+    round programs statically slice them away before forging/aggregation —
+    set :attr:`~blades_tpu.core.FedRound.num_clients` to the true count
+    (``FedavgConfig`` does this automatically).
     """
     cs = client_axis_sharding(mesh)
     rep = replicated_sharding(mesh)
     import dataclasses as _dc
 
+    n_dev = mesh.devices.size
     server = jax.device_put(round_state.server, rep)
-    client_opt = jax.tree.map(lambda a: jax.device_put(a, cs), round_state.client_opt)
+    client_opt = jax.tree.map(
+        lambda a: jax.device_put(pad_to_multiple(a, n_dev), cs),
+        round_state.client_opt,
+    )
     state = _dc.replace(round_state, server=server, client_opt=client_opt)
-    data = tuple(jax.device_put(a, cs) for a in data_arrays)
+    data = tuple(
+        jax.device_put(pad_to_multiple(a, n_dev), cs) for a in data_arrays
+    )
     return state, data

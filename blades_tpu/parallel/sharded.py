@@ -123,13 +123,12 @@ def shard_map_step(fr: FedRound, mesh: Mesh) -> Callable:
         bx, by = sample_client_batches(
             k_sample, data_x, data_y, lengths, fr.batch_size, fr.num_batches_per_round
         )
-        data_hook, grad_hook = fr._hooks()
+        hooks = fr._hooks()
         client_keys = jax.random.split(k_train, n_local)
 
         def one_client(opt_state, cbx, cby, ck, mal):
             return fr.task.local_round(
-                state.server.params, opt_state, cbx, cby, ck, mal,
-                data_hook, grad_hook,
+                state.server.params, opt_state, cbx, cby, ck, mal, *hooks
             )
 
         upd_local, client_opt, losses_local = jax.vmap(one_client)(
@@ -144,6 +143,10 @@ def shard_map_step(fr: FedRound, mesh: Mesh) -> Callable:
         updates = lax.all_gather(upd_local, axis, axis=0, tiled=True)
         mal_all = lax.all_gather(malicious, axis, axis=0, tiled=True)
         losses = lax.all_gather(losses_local, axis, axis=0, tiled=True)
+        # Drop ghost (padding) lanes — see FedRound.num_clients.
+        k = fr.num_clients
+        if k is not None and k < updates.shape[0]:
+            updates, mal_all, losses = updates[:k], mal_all[:k], losses[:k]
 
         if fr.adversary is not None and hasattr(fr.adversary, "on_updates_ready"):
             updates = fr.adversary.on_updates_ready(

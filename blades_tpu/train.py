@@ -23,8 +23,26 @@ def main(argv=None) -> int:
     p_file.add_argument("--storage-path", default="~/blades_tpu_results")
     p_file.add_argument("--checkpoint-freq", type=int, default=0)
     p_file.add_argument("--checkpoint-at-end", action="store_true")
+    p_file.add_argument("--checkpoint-keep-num", type=int, default=None,
+                        help="keep only the N best periodic checkpoints "
+                        "(ref: blades/train.py:175-180)")
+    p_file.add_argument("--checkpoint-score-attr", default="training_iteration",
+                        help="result key ranking checkpoints for --checkpoint-"
+                        "keep-num (e.g. test_acc)")
+    p_file.add_argument("--resume", action="store_true",
+                        help="skip finished trials, restore in-flight ones "
+                        "from their latest checkpoint (ref: blades/"
+                        "train.py:154,228)")
     p_file.add_argument("--max-rounds", type=int, default=None,
                         help="override every experiment's training_iteration")
+    p_file.add_argument("--coordinator", default=None, metavar="HOST:PORT",
+                        help="multi-host bring-up via jax.distributed — the "
+                        "TPU-native replacement for the reference's NCCL "
+                        "init_process_group (ref: fllib/communication/"
+                        "communicator.py:148); also honours "
+                        "JAX_COORDINATOR_ADDRESS")
+    p_file.add_argument("--num-processes", type=int, default=None)
+    p_file.add_argument("--process-id", type=int, default=None)
     p_file.add_argument("--trace", default=None, metavar="DIR",
                         help="capture a jax profiler trace into DIR "
                         "(the reference's --trace flag is dead code; this "
@@ -45,6 +63,11 @@ def main(argv=None) -> int:
     from blades_tpu.tune import load_experiments_from_file, run_experiments
 
     if args.cmd == "file":
+        # Must run before any other jax call (see init_distributed); no-op
+        # when neither --coordinator nor JAX_COORDINATOR_ADDRESS is set.
+        from blades_tpu.parallel import init_distributed
+
+        init_distributed(args.coordinator, args.num_processes, args.process_id)
         experiments = load_experiments_from_file(args.experiment_file)
 
         def _run():
@@ -54,6 +77,9 @@ def main(argv=None) -> int:
                 verbose=args.verbose,
                 checkpoint_freq=args.checkpoint_freq,
                 checkpoint_at_end=args.checkpoint_at_end,
+                checkpoint_keep_num=args.checkpoint_keep_num,
+                checkpoint_score_attr=args.checkpoint_score_attr,
+                resume=args.resume,
                 max_rounds_override=args.max_rounds,
             )
 

@@ -1,0 +1,104 @@
+"""Experiment-parallelism: seed-replicate trials as vmapped lanes.
+
+The reference runs Tune trials concurrently across a Ray cluster
+(SURVEY.md §2.9, ref: blades/train.py:380-386).  On TPU the analogue for
+the canonical seed sweep (``seed: grid_search: [121..125]``, ref:
+fedavg_dp.yaml:7-9) is ONE jit program with a leading trial axis: every
+trial shares shapes and static config (model, aggregator, adversary), so
+the whole federated round vmaps over (per-seed state, per-seed data
+partition, per-seed key stream) and L trials cost one dispatch per round
+instead of L.
+
+Per-lane RNG mirrors the sequential driver exactly — lane i carries the
+key stream of ``PRNGKey(seed_i)`` with the same split discipline as
+``Fedavg`` — so a vmapped lane reproduces its sequential trial.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def run_seed_lanes(config, seeds: List[int], max_rounds: int) -> List[List[Dict]]:
+    """Run one trial per seed as vmapped lanes of a single program.
+
+    Args:
+        config: a built-up (not yet frozen) ``FedavgConfig``; its ``seed``
+            field is overridden per lane.
+        seeds: one trial per entry.
+        max_rounds: FL rounds per trial.
+
+    Returns:
+        Per seed, the list of per-round result dicts (Tune's
+        ``result.json`` rows: training_iteration, train_loss, test_acc...).
+    """
+    from blades_tpu.adversaries import make_malicious_mask
+    from blades_tpu.data import DatasetCatalog
+
+    config.validate()
+    fr = config.get_fed_round()
+    L = len(seeds)
+
+    # Per-seed data partitions, stacked on a leading lane axis.
+    stacks = {"x": [], "y": [], "ln": [], "tx": [], "ty": [], "tln": []}
+    for s in seeds:
+        ds = DatasetCatalog.get_dataset(
+            config.dataset, num_clients=config.num_clients, iid=config.iid,
+            alpha=config.dirichlet_alpha, seed=s,
+        )
+        stacks["x"].append(ds.train.x)
+        stacks["y"].append(ds.train.y)
+        stacks["ln"].append(ds.train.lengths)
+        stacks["tx"].append(ds.test.x)
+        stacks["ty"].append(ds.test.y)
+        stacks["tln"].append(ds.test.lengths)
+    # Shard sizes can differ per seed under Dirichlet; pad to the widest.
+    def stack(arrs):
+        cap = max(a.shape[1] for a in arrs) if arrs[0].ndim > 1 else None
+        if cap is not None:
+            arrs = [
+                np.pad(a, [(0, 0), (0, cap - a.shape[1])] + [(0, 0)] * (a.ndim - 2))
+                for a in arrs
+            ]
+        return jnp.asarray(np.stack(arrs))
+
+    x, y, ln = stack(stacks["x"]), stack(stacks["y"]), stack(stacks["ln"])
+    tx, ty, tln = stack(stacks["tx"]), stack(stacks["ty"]), stack(stacks["tln"])
+    mal = make_malicious_mask(config.num_clients, config.num_malicious_clients)
+
+    # Lane key streams, identical to the sequential driver's.
+    keys = jax.vmap(lambda s: jax.random.PRNGKey(s))(jnp.asarray(seeds))
+    init_keys, carry = jnp.moveaxis(jax.vmap(jax.random.split)(keys), 1, 0)
+
+    states = jax.vmap(fr.init, in_axes=(0, None))(init_keys, config.num_clients)
+    step = jax.jit(jax.vmap(fr.step, in_axes=(0, 0, 0, 0, None, 0)))
+    evaluate = jax.jit(jax.vmap(fr.evaluate, in_axes=(0, 0, 0, 0)))
+
+    interval = config.evaluation_interval
+    results: List[List[Dict]] = [[] for _ in range(L)]
+    last_eval: List[Dict] = [{} for _ in range(L)]
+    for r in range(1, max_rounds + 1):
+        round_keys, carry = jnp.moveaxis(jax.vmap(jax.random.split)(carry), 1, 0)
+        states, metrics = step(states, x, y, ln, mal, round_keys)
+        if interval and r % interval == 0:
+            ev = evaluate(states, tx, ty, tln)
+            last_eval = [
+                {k: float(ev[k][i]) for k in ("test_loss", "test_acc",
+                                              "test_acc_top3")}
+                for i in range(L)
+            ]
+        for i in range(L):
+            row = {
+                "training_iteration": r,
+                "train_loss": float(metrics["train_loss"][i]),
+                "agg_norm": float(metrics["agg_norm"][i]),
+                "update_norm_mean": float(metrics["update_norm_mean"][i]),
+                "seed": int(seeds[i]),
+            }
+            row.update(last_eval[i])
+            results[i].append(row)
+    return results

@@ -83,6 +83,47 @@ def _trial_name(base: str, idx: int, trial_cfg: Dict) -> str:
     return f"{base}_{idx:05d}"
 
 
+def _read_results(path: Path) -> List[Dict]:
+    """Parse a trial's ``result.json`` line stream (tolerant of a torn
+    final line from a killed run)."""
+    rows = []
+    if not path.exists():
+        return rows
+    for line in path.read_text().splitlines():
+        try:
+            rows.append(json.loads(line))
+        except json.JSONDecodeError:
+            break
+    return rows
+
+
+def _latest_checkpoint(tdir: Path) -> Optional[Path]:
+    """Newest periodic checkpoint by round number (``ckpt_<round>``)."""
+    ckpts = sorted(
+        (p for p in tdir.glob("ckpt_*") if p.name != "ckpt_final"),
+        key=lambda p: p.name,
+    )
+    return ckpts[-1] if ckpts else None
+
+
+def _prune_checkpoints(
+    tdir: Path, keep_num: Optional[int], scores: Dict[str, float]
+) -> None:
+    """Keep the ``keep_num`` best checkpoints (by recorded score, newest
+    breaking ties) — the reference CLI's checkpoint_keep_num/score_attr
+    policy (ref: blades/train.py:175-180)."""
+    if not keep_num:
+        return
+    ckpts = [p for p in tdir.glob("ckpt_*") if p.name != "ckpt_final"]
+    if len(ckpts) <= keep_num:
+        return
+    ckpts.sort(key=lambda p: (scores.get(p.name, float("-inf")), p.name))
+    import shutil
+
+    for p in ckpts[: len(ckpts) - keep_num]:
+        shutil.rmtree(p, ignore_errors=True)
+
+
 def run_experiments(
     experiments: Dict[str, Dict],
     storage_path: str = "~/blades_tpu_results",
@@ -90,11 +131,22 @@ def run_experiments(
     checkpoint_freq: int = 0,
     checkpoint_at_end: bool = False,
     max_rounds_override: Optional[int] = None,
+    resume: bool = False,
+    checkpoint_keep_num: Optional[int] = None,
+    checkpoint_score_attr: str = "training_iteration",
 ) -> List[Dict]:
     """Run every trial of every experiment sequentially; returns summaries.
 
     Per trial: ``result.json`` (one JSON line per round, Tune's format) and
     ``params.json`` in ``<storage>/<experiment>/<trial>/``.
+
+    ``resume=True`` (the reference CLI's ``--restore``/``resume``, ref:
+    blades/train.py:154,228): trials whose ``result.json`` already reached
+    the stop criterion are skipped; in-flight trials restore from their
+    latest periodic checkpoint and continue appending.  A 2000-round grid
+    killed at any point picks up without redoing finished work.
+    ``checkpoint_keep_num`` bounds on-disk checkpoints, keeping the best by
+    ``checkpoint_score_attr`` (newest on ties).
     """
     from blades_tpu.algorithms import get_algorithm_class
 
@@ -108,15 +160,41 @@ def run_experiments(
             tname = _trial_name(exp_name, i, trial_cfg)
             tdir = root / exp_name / tname
             tdir.mkdir(parents=True, exist_ok=True)
+            prior = _read_results(tdir / "result.json") if resume else []
+            best_acc = max((r.get("test_acc", 0.0) for r in prior), default=0.0)
+            done = prior[-1].get("training_iteration", 0) if prior else 0
+            if resume and done >= max_rounds:
+                summary = {
+                    "trial": tname, "rounds": done, "wall_s": 0.0,
+                    "rounds_per_sec": None, "best_test_acc": best_acc,
+                    "final": {}, "dir": str(tdir), "resumed": "skipped",
+                }
+                if verbose:
+                    print(f"== trial {tname}: finished ({done} rounds), "
+                          "skipping ==", flush=True)
+                summaries.append(summary)
+                continue
             algo_cls, config = get_algorithm_class(spec["run"], return_config=True)
             config.update_from_dict(trial_cfg)
             algo = config.build()
+            resumed_from = None
+            if resume:
+                ckpt = _latest_checkpoint(tdir)
+                if ckpt is not None:
+                    algo.load_checkpoint(str(ckpt))
+                    resumed_from = algo.iteration
             with open(tdir / "params.json", "w") as f:
                 json.dump(_jsonable(trial_cfg), f, indent=2, default=str)
             if verbose:
-                print(f"== trial {tname}: {max_rounds} rounds ==", flush=True)
-            best_acc, t0 = 0.0, time.perf_counter()
-            with open(tdir / "result.json", "w") as f:
+                tag = (f" (resumed @ round {resumed_from})"
+                       if resumed_from else "")
+                print(f"== trial {tname}: {max_rounds} rounds{tag} ==",
+                      flush=True)
+            t0 = time.perf_counter()
+            start_round = algo.iteration
+            ckpt_scores: Dict[str, float] = {}
+            mode = "a" if resumed_from else "w"
+            with open(tdir / "result.json", mode) as f:
                 # Stop on training_iteration (actual FL rounds), not train()
                 # calls — one call advances rounds_per_dispatch rounds.
                 while algo.iteration < max_rounds:
@@ -125,18 +203,26 @@ def run_experiments(
                     f.write(json.dumps(_jsonable(result)) + "\n")
                     best_acc = max(best_acc, result.get("test_acc", 0.0))
                     if checkpoint_freq and algo.iteration % checkpoint_freq == 0:
-                        algo.save_checkpoint(str(tdir / f"ckpt_{algo.iteration:06d}"))
+                        name = f"ckpt_{algo.iteration:06d}"
+                        algo.save_checkpoint(str(tdir / name))
+                        ckpt_scores[name] = float(
+                            result.get(checkpoint_score_attr, algo.iteration)
+                        )
+                        _prune_checkpoints(tdir, checkpoint_keep_num, ckpt_scores)
                     if verbose > 1 and algo.iteration % 10 == 0:
                         print(f"  round {algo.iteration}: {result}", flush=True)
             if checkpoint_at_end:
                 algo.save_checkpoint(str(tdir / "ckpt_final"))
             wall = time.perf_counter() - t0
+            new_rounds = algo.iteration - start_round
             summary = {
                 "trial": tname, "rounds": algo.iteration, "wall_s": round(wall, 2),
-                "rounds_per_sec": round(algo.iteration / wall, 2),
+                "rounds_per_sec": round(new_rounds / wall, 2) if wall else None,
                 "best_test_acc": best_acc, "final": algo._last_eval,
                 "dir": str(tdir),
             }
+            if resumed_from is not None:
+                summary["resumed"] = f"from round {resumed_from}"
             if verbose:
                 print(f"   -> {summary}", flush=True)
             summaries.append(summary)

@@ -1,0 +1,22 @@
+"""The accuracy-curve harness (VERDICT r1 #10): one command -> PNG + JSON."""
+
+import json
+
+
+def test_accuracy_curves_one_command(tmp_path):
+    from blades_tpu.benchmarks.accuracy_curves import main
+
+    rc = main([
+        "--dataset", "mnist", "--rounds", "6", "--num-clients", "8",
+        "--aggregators", "Mean", "Median", "--malicious", "0", "2",
+        "--rounds-per-dispatch", "3", "--out", str(tmp_path),
+    ])
+    assert rc == 0
+    table = json.loads((tmp_path / "curves.json").read_text())
+    assert len(table["rows"]) == 4
+    assert "SYNTHETIC" in table["source"]  # no raw files in CI
+    for row in table["rows"]:
+        assert row["rounds"] == 6
+        assert 0.0 <= row["final_test_acc"] <= 1.0
+    png = (tmp_path / "curves.png").read_bytes()
+    assert png[:8] == b"\x89PNG\r\n\x1a\n"

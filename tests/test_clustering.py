@@ -71,3 +71,101 @@ def test_agglomerative_matches_scipy_average_linkage():
 
     mask = np.asarray(agglomerative_majority(jnp.asarray(d), linkage="average"))
     assert (mask == expected).all()
+
+
+def _naive_single_linkage_2(d):
+    """O(n^3) reference single-linkage down to 2 clusters (numpy)."""
+    n = d.shape[0]
+    clusters = [[i] for i in range(n)]
+    while len(clusters) > 2:
+        best, pair = np.inf, None
+        for i in range(len(clusters)):
+            for j in range(i + 1, len(clusters)):
+                dd = min(d[a, b] for a in clusters[i] for b in clusters[j])
+                if dd < best:
+                    best, pair = dd, (i, j)
+        i, j = pair
+        clusters[i] = clusters[i] + clusters[j]
+        del clusters[j]
+    a, b = clusters
+    big = a if len(a) > len(b) else b if len(b) > len(a) else (a if 0 in a else b)
+    mask = np.zeros(n, dtype=bool)
+    mask[big] = True
+    return mask
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_mst_single_linkage_matches_reference(seed):
+    """The MST formulation is EXACTLY single-linkage-cut-at-2 (VERDICT r1
+    #8 replaced the O(n^3) merge loop with Prim + heaviest-edge cut)."""
+    rng = np.random.default_rng(seed)
+    pts = rng.normal(size=(14, 3))
+    d = np.linalg.norm(pts[:, None] - pts[None, :], axis=-1)
+    expected = _naive_single_linkage_2(d)
+    mask = np.asarray(agglomerative_majority(jnp.asarray(d), linkage="single"))
+    assert (mask == expected).all()
+
+
+def test_spectral_bipartition_matches_exact_on_separated_blobs():
+    """Above the exactness threshold, average linkage takes the spectral
+    path; on separated geometry both agree."""
+    pts = np.asarray(two_blobs(n_a=130, n_b=70, sep=10.0))
+    d = np.linalg.norm(pts[:, None] - pts[None, :], axis=-1)
+    d = d / d.max() * 2.0  # cosine-like range [0, 2]
+    dj = jnp.asarray(d)
+    spectral = np.asarray(agglomerative_majority(dj, linkage="average"))
+    exact = np.asarray(
+        agglomerative_majority(dj, linkage="average", exact_threshold=512)
+    )
+    assert (spectral == exact).all()
+    assert spectral[:130].all() and not spectral[130:].any()
+
+
+@pytest.mark.parametrize("linkage", ["single", "average"])
+def test_clustering_scales_to_1000(linkage):
+    """n=1000 clustering step must complete in ~1s (VERDICT r1 #8)."""
+    import time
+
+    rng = np.random.default_rng(0)
+    mu_a = np.zeros(8); mu_a[0] = 3.0
+    mu_b = np.zeros(8); mu_b[1] = 3.0
+    # Two tight cones of directions: intra-cosine-distance << inter, so
+    # single linkage's bridge edge IS the inter-cluster gap (a blob at the
+    # origin would give random directions and legitimate chaining).
+    pts = np.concatenate([
+        rng.normal(size=(750, 8)) * 0.1 + mu_a,
+        rng.normal(size=(250, 8)) * 0.1 + mu_b,
+    ]).astype(np.float32)
+    norm = pts / np.linalg.norm(pts, axis=1, keepdims=True)
+    d = jnp.asarray(np.clip(1.0 - norm @ norm.T, 0.0, 2.0))
+    mask = agglomerative_majority(d, linkage=linkage)  # compile
+    t0 = time.perf_counter()
+    mask = np.asarray(agglomerative_majority(d, linkage=linkage))
+    dt = time.perf_counter() - t0
+    assert dt < 2.0, f"{linkage} clustering took {dt:.2f}s at n=1000"
+    assert mask.sum() == 750
+
+
+def test_clippedclustering_aggregates_1000_clients():
+    """The full Clippedclustering aggregator at the north-star client
+    count: must run (and fast) now that the merge loop is gone."""
+    import time
+
+    from blades_tpu.ops.aggregators import Clippedclustering
+
+    rng = np.random.default_rng(1)
+    updates = jnp.asarray(np.concatenate([
+        rng.normal(size=(800, 2000)) * 0.1,
+        rng.normal(size=(200, 2000)) * 0.1 + 1.0,
+    ]).astype(np.float32))
+    agg = Clippedclustering()
+    state = agg.init(2000, 1000)
+    call = jax.jit(lambda u, s: agg(u, s))
+    out, state = call(updates, state)  # compile
+    t0 = time.perf_counter()
+    out, state = call(updates, state)
+    out.block_until_ready()
+    dt = time.perf_counter() - t0
+    assert dt < 2.0, f"Clippedclustering at n=1000 took {dt:.2f}s"
+    assert np.isfinite(np.asarray(out)).all()
+    assert np.abs(np.asarray(out)).max() < 0.5  # attackers rejected

@@ -127,3 +127,54 @@ def test_run_experiments_counts_rounds_not_calls(tmp_path):
     lines = (Path(s["dir"]) / "result.json").read_text().strip().splitlines()
     assert len(lines) == 2  # two dispatches of 3 rounds
     assert json.loads(lines[-1])["training_iteration"] == 6
+
+
+def _resume_experiments(rounds):
+    return {
+        "resumable": {
+            "run": "FEDAVG",
+            "stop": {"training_iteration": rounds},
+            "config": {
+                "dataset_config": {"type": "mnist", "num_clients": 4, "train_bs": 8},
+                "global_model": "mlp",
+                "evaluation_interval": 2,
+                "server_config": {"lr": 1.0},
+            },
+        }
+    }
+
+
+def test_sweep_resume_kill_and_rerun(tmp_path):
+    """The reference CLI's --restore/resume semantics (ref: blades/
+    train.py:154,228): a killed grid continues from checkpoints without
+    redoing finished trials."""
+    # Phase 1: "killed" after 4 of 8 rounds (checkpoint every 2).
+    run_experiments(_resume_experiments(4), storage_path=str(tmp_path),
+                    verbose=0, checkpoint_freq=2)
+    tdir = tmp_path / "resumable" / "resumable_00000"
+    assert (tdir / "ckpt_000004").exists()
+
+    # Phase 2: resume to 8 rounds — must restore from round 4, not restart.
+    [s] = run_experiments(_resume_experiments(8), storage_path=str(tmp_path),
+                          verbose=0, checkpoint_freq=2, resume=True)
+    assert s["resumed"] == "from round 4"
+    assert s["rounds"] == 8
+    lines = (tdir / "result.json").read_text().strip().splitlines()
+    iters = [json.loads(ln)["training_iteration"] for ln in lines]
+    assert iters == [1, 2, 3, 4, 5, 6, 7, 8]  # appended, no rework
+
+    # Phase 3: rerun — the finished trial is skipped untouched.
+    mtime = (tdir / "result.json").stat().st_mtime
+    [s2] = run_experiments(_resume_experiments(8), storage_path=str(tmp_path),
+                           verbose=0, resume=True)
+    assert s2["resumed"] == "skipped"
+    assert s2["rounds"] == 8
+    assert (tdir / "result.json").stat().st_mtime == mtime
+
+
+def test_sweep_checkpoint_keep_num(tmp_path):
+    run_experiments(_resume_experiments(8), storage_path=str(tmp_path),
+                    verbose=0, checkpoint_freq=2, checkpoint_keep_num=2)
+    tdir = tmp_path / "resumable" / "resumable_00000"
+    kept = sorted(p.name for p in tdir.glob("ckpt_*"))
+    assert kept == ["ckpt_000006", "ckpt_000008"]
