@@ -74,6 +74,9 @@ class FedavgConfig:
         self.compute_dtype: Any = None
         # rounds fused per device dispatch (lax.scan); 1 = round-per-call
         self.rounds_per_dispatch: int = 1
+        # failure detection / elastic recovery (core/health.py): zero
+        # non-finite client lanes, skip non-finite server updates
+        self.health_check: bool = False
         # server root-dataset size for trust-bootstrapped aggregators (FLTrust)
         self.fltrust_root_size: int = 100
         # resources
@@ -125,6 +128,11 @@ class FedavgConfig:
 
     def resources(self, *, num_devices=None):
         return self._set(num_devices=num_devices)
+
+    def fault_tolerance(self, *, health_check=None):
+        """In-round failure detection / elastic recovery (core/health.py);
+        the trial-level analogue is ``run_experiments(max_failures=)``."""
+        return self._set(health_check=health_check)
 
     # -- dict shim (ref: algorithm_config.py:253-293,360-379) ----------------
 
@@ -271,6 +279,7 @@ class FedavgConfig:
             # True federation size: ghost lanes from mesh padding (see
             # shard_federation) are sliced out of forging/aggregation.
             num_clients=self.num_clients,
+            health_check=self.health_check,
         )
 
     def build(self):

@@ -151,6 +151,9 @@ class Fedavg:
             "update_norm_mean": metrics["update_norm_mean"],
             "timers": self.timers.summary(),
         }
+        if self.config.health_check:  # failure-detection metrics (health.py)
+            result["num_unhealthy"] = int(metrics["num_unhealthy"])
+            result["round_ok"] = bool(metrics["round_ok"])
         # Rounds-since-last-eval cadence: robust to rounds_per_dispatch not
         # dividing evaluation_interval (a modulo test would then never fire).
         if self.config.evaluation_interval and (

@@ -17,3 +17,7 @@ pure functions over stacked arrays:
 from blades_tpu.core.task import Task, TaskSpec  # noqa: F401
 from blades_tpu.core.server import Server, ServerState  # noqa: F401
 from blades_tpu.core.round import FedRound, RoundState  # noqa: F401
+from blades_tpu.core.health import (  # noqa: F401
+    guard_server_state,
+    sanitize_updates,
+)

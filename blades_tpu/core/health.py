@@ -1,0 +1,60 @@
+"""Failure detection + elastic recovery, TPU-native (SURVEY.md §5).
+
+The reference inherits Ray's fault machinery: ``FaultTolerantActorManager``
+marks actors unhealthy and routes around them (ref: fllib/core/execution/
+actor_manager.py:25, worker_group.py:95-127), and Ray Tune retries failed
+trials.  On a TPU mesh there are no actors to health-check — a "failed
+client" is a *lane of the update matrix gone bad* (diverged local SGD,
+corrupt shard, overflow), and a "failed round" is a non-finite aggregate.
+Both are detectable and recoverable inside the jitted program:
+
+- **detect**: a client lane is unhealthy iff its update row contains a
+  non-finite value; the round is bad iff the aggregate does.
+- **recover (client)**: zero the unhealthy rows.  A zero row is an
+  *arbitrary-but-finite* vector, exactly the fault model the robust
+  aggregators are built to tolerate (and for plain Mean it is the neutral
+  element up to the 1/n scale) — the defense layer doubles as the elastic
+  recovery layer.
+- **recover (round)**: if the aggregate itself is non-finite, skip the
+  server update (keep params/opt/agg state, advance the round counter) —
+  the array-native analogue of "restart the failed worker and retry".
+
+Process-level failures (a crashed trial) are handled host-side by the
+sweep runner's checkpoint-restart policy (``max_failures`` in
+:func:`blades_tpu.tune.sweep.run_experiments`), mirroring Tune.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def sanitize_updates(updates: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Detect and neutralise unhealthy client lanes.
+
+    Args:
+        updates: ``(n, d)`` stacked client update matrix.
+
+    Returns:
+        ``(clean, healthy)`` — the matrix with every non-finite entry
+        zeroed, and the ``(n,)`` bool lane-health mask (True = finite row).
+    """
+    finite = jnp.isfinite(updates)
+    healthy = finite.all(axis=-1)
+    return jnp.where(finite, updates, 0.0), healthy
+
+
+def guard_server_state(ok: jax.Array, new: Any, old: Any) -> Any:
+    """Select the new server state when ``ok``, else keep the old one —
+    except the round counter, which always advances (the round *happened*,
+    its update was just discarded).
+
+    ``new``/``old`` are :class:`~blades_tpu.core.server.ServerState`
+    pytrees; ``ok`` is a scalar bool traced inside jit.
+    """
+    guarded = jax.tree.map(lambda n, o: jnp.where(ok, n, o), new, old)
+    guarded.round = new.round
+    return guarded

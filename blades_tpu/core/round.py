@@ -81,6 +81,12 @@ class FedRound:
     # composing BEFORE the adversary's hooks (the reference appends the
     # attack callback last).
     client_callbacks: Tuple = ()
+    # Failure detection + elastic recovery (see core/health.py): zero
+    # non-finite client lanes before aggregation and skip the server
+    # update when the aggregate itself is non-finite.  Adds
+    # ``num_unhealthy``/``round_ok`` metrics.  Costs one extra pass over
+    # the update matrix, so opt-in.
+    health_check: bool = False
 
     # -- construction -------------------------------------------------------
 
@@ -159,6 +165,11 @@ class FedRound:
         k = self.num_clients
         if k is not None and k < updates.shape[0]:
             updates, losses, malicious = updates[:k], losses[:k], malicious[:k]
+        healthy = None
+        if self.health_check:
+            from blades_tpu.core.health import sanitize_updates
+
+            updates, healthy = sanitize_updates(updates)
         updates = self.apply_dp(updates, k_dp)
 
         if self.adversary is not None and hasattr(self.adversary, "on_updates_ready"):
@@ -182,6 +193,13 @@ class FedRound:
             "agg_norm": jnp.linalg.norm(agg),
             "round": server.round,
         }
+        if self.health_check:
+            from blades_tpu.core.health import guard_server_state
+
+            ok = jnp.isfinite(agg).all()
+            server = guard_server_state(ok, server, state.server)
+            metrics["num_unhealthy"] = (~healthy).sum()
+            metrics["round_ok"] = ok
         return RoundState(server=server, client_opt=client_opt), metrics
 
     def multi_step(

@@ -11,6 +11,7 @@ from typing import Callable, Dict
 
 import flax.linen as nn
 
+from blades_tpu.models.cct import VARIANTS as _CCT_VARIANTS
 from blades_tpu.models.cct import cct_2_3x2_32
 from blades_tpu.models.cnn import FashionCNN
 from blades_tpu.models.mlp import MLP
@@ -42,24 +43,33 @@ def register_model(name: str, builder: Callable[..., nn.Module]) -> None:
 
 class ModelCatalog:
     @staticmethod
-    def get_model(spec, num_classes: int = 10) -> nn.Module:
+    def get_model(spec, num_classes=None) -> nn.Module:
+        """Resolve ``spec`` to a linen module.
+
+        ``num_classes=None`` keeps each builder's own default — so presets
+        that carry a class count in the name (e.g. ``cct_7_3x1_32_c100``
+        defaults to 100) are not silently overridden to 10.
+        """
         if isinstance(spec, nn.Module):
             return spec
         if callable(spec) and not isinstance(spec, str):
             return spec()
+        kw = {} if num_classes is None else {"num_classes": num_classes}
         name = str(spec).lower()
         if name in _CUSTOM:
-            return _CUSTOM[name](num_classes=num_classes)
+            return _CUSTOM[name](**kw)
         if name in _RESNETS:
-            return _RESNETS[name](num_classes=num_classes)
+            return _RESNETS[name](**kw)
+        if name in _CCT_VARIANTS:
+            return _CCT_VARIANTS[name](**kw)
         # Substring matching, same precedence as the reference
         # (ref: fllib/models/catalog.py:16-29): "resnet" -> ResNet10.
         if "cct" in name:
-            return cct_2_3x2_32(num_classes=num_classes)
+            return cct_2_3x2_32(**kw)
         if "resnet" in name:
-            return ResNet10(num_classes=num_classes)
+            return ResNet10(**kw)
         if "mlp" in name:
-            return MLP(num_classes=num_classes)
+            return MLP(**kw)
         if "cnn" in name:
-            return FashionCNN(num_classes=num_classes)
+            return FashionCNN(**kw)
         raise KeyError(f"unknown model {spec!r}")

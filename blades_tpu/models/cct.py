@@ -103,9 +103,12 @@ class CCT(nn.Module):
     stochastic_depth: float = 0.1
     img_size: int = 32
 
+    def tokenize(self, x):
+        return Tokenizer(self.embed_dim, self.kernel_size, self.n_conv_layers)(x)
+
     @nn.compact
     def __call__(self, x, *, train: bool = False):
-        x = Tokenizer(self.embed_dim, self.kernel_size, self.n_conv_layers)(x)
+        x = self.tokenize(x)
         seq_len = x.shape[1]
         if self.positional_embedding == "learnable":
             pe = self.param(
@@ -134,28 +137,108 @@ class CCT(nn.Module):
         return nn.Dense(self.num_classes)(x)
 
 
-def cct_2_3x2_32(num_classes: int = 10, positional_embedding: str = "learnable") -> CCT:
-    """CCT-2/3x2 for 32x32 (the catalog default, ref: fllib/models/catalog.py:18)."""
-    return CCT(
-        num_classes=num_classes, embed_dim=128, num_layers=2, num_heads=2,
-        mlp_ratio=1.0, kernel_size=3, n_conv_layers=2,
-        positional_embedding=positional_embedding,
-    )
+class CVT(CCT):
+    """Compact Vision Transformer (ref: cctnets/cvt.py:17-70): identical
+    encoder + SeqPool head, but the tokenizer is a patch embedding — one
+    conv with stride == kernel == patch size (default 4, ref: cvt.py:79),
+    bias, no pooling."""
+
+    kernel_size: int = 4  # patch size
+
+    def tokenize(self, x):
+        k = self.kernel_size
+        x = nn.Conv(self.embed_dim, (k, k), strides=(k, k), padding=0,
+                    use_bias=True)(x)
+        return x.reshape((x.shape[0], -1, x.shape[-1]))  # (B, seq, dim)
 
 
-def cct_4_3x2_32(num_classes: int = 10, positional_embedding: str = "learnable") -> CCT:
-    return CCT(
-        num_classes=num_classes, embed_dim=128, num_layers=4, num_heads=2,
-        mlp_ratio=1.0, kernel_size=3, n_conv_layers=2,
-        positional_embedding=positional_embedding,
-    )
+# ---------------------------------------------------------------------------
+# Variant zoo (ref: cctnets/cct.py:132-658, cvt.py:107-321).
+#
+# Depth tiers (ref: cct_2/4/6/7/14 at cct.py:132-201, cvt_2/4/6/7 at
+# cvt.py:107-129): (num_layers, num_heads, mlp_ratio, embed_dim).
+# Named variants encode <tier>_<kernel>x<n_conv>_<img> for CCT and
+# <tier>_<patch>_<img> for CVT; `_sine` names fix sinusoidal positional
+# embeddings, `_c100` names default to 100 classes (CIFAR-100 presets,
+# ref: cct.py:443-490).
+# ---------------------------------------------------------------------------
+
+_TIERS = {
+    2: (2, 2, 1.0, 128),
+    4: (4, 2, 1.0, 128),
+    6: (6, 4, 2.0, 256),
+    7: (7, 4, 2.0, 256),
+    14: (14, 6, 3.0, 384),
+}
+
+# (tier, kernel_size, n_conv_layers, img_size) — the reference's named set.
+_CCT_VARIANTS = [
+    (2, 3, 2, 32),
+    (4, 3, 2, 32),
+    (6, 3, 1, 32),
+    (6, 3, 2, 32),
+    (7, 3, 1, 32),
+    (7, 3, 2, 32),
+    (7, 7, 2, 224),
+    (14, 7, 2, 224),
+    (14, 7, 2, 384),
+]
+
+# (tier, patch_size, img_size) for CVT (ref: cvt.py:138-321).
+_CVT_VARIANTS = [(2, 4, 32), (4, 4, 32), (6, 4, 32), (7, 4, 32)]
+
+VARIANTS = {}
 
 
-def cct_7_3x1_32(num_classes: int = 10, positional_embedding: str = "learnable") -> CCT:
-    return CCT(
-        num_classes=num_classes, embed_dim=256, num_layers=7, num_heads=4,
-        mlp_ratio=2.0, kernel_size=3, n_conv_layers=1,
-        positional_embedding=positional_embedding,
-    )
+def _make_cct(tier, kernel, n_conv, img, pe, default_classes=10):
+    layers, heads, mlp, dim = _TIERS[tier]
+
+    def build(num_classes: int = default_classes,
+              positional_embedding: str = pe) -> CCT:
+        return CCT(
+            num_classes=num_classes, embed_dim=dim, num_layers=layers,
+            num_heads=heads, mlp_ratio=mlp, kernel_size=kernel,
+            n_conv_layers=n_conv, positional_embedding=positional_embedding,
+            img_size=img,
+        )
+
+    return build
+
+
+def _make_cvt(tier, patch, img, pe):
+    layers, heads, mlp, dim = _TIERS[tier]
+
+    def build(num_classes: int = 10,
+              positional_embedding: str = pe) -> CVT:
+        return CVT(
+            num_classes=num_classes, embed_dim=dim, num_layers=layers,
+            num_heads=heads, mlp_ratio=mlp, kernel_size=patch,
+            positional_embedding=positional_embedding, img_size=img,
+        )
+
+    return build
+
+
+for _t, _k, _c, _s in _CCT_VARIANTS:
+    _base = f"cct_{_t}_{_k}x{_c}_{_s}"
+    VARIANTS[_base] = _make_cct(_t, _k, _c, _s, "learnable")
+    VARIANTS[f"{_base}_sine"] = _make_cct(_t, _k, _c, _s, "sine")
+for _t, _p, _s in _CVT_VARIANTS:
+    _base = f"cvt_{_t}_{_p}_{_s}"
+    VARIANTS[_base] = _make_cvt(_t, _p, _s, "learnable")
+    VARIANTS[f"{_base}_sine"] = _make_cvt(_t, _p, _s, "sine")
+# CIFAR-100 presets (ref: cct.py:443-490).
+VARIANTS["cct_7_3x1_32_c100"] = _make_cct(7, 3, 1, 32, "learnable",
+                                          default_classes=100)
+VARIANTS["cct_7_3x1_32_sine_c100"] = _make_cct(7, 3, 1, 32, "sine",
+                                               default_classes=100)
+
+globals().update(VARIANTS)
+
+# Keep explicit names for the most-used variants (import surface + IDEs).
+cct_2_3x2_32 = VARIANTS["cct_2_3x2_32"]
+cct_4_3x2_32 = VARIANTS["cct_4_3x2_32"]
+cct_7_3x1_32 = VARIANTS["cct_7_3x1_32"]
+cvt_7_4_32 = VARIANTS["cvt_7_4_32"]
 
 

@@ -147,6 +147,11 @@ def shard_map_step(fr: FedRound, mesh: Mesh) -> Callable:
         k = fr.num_clients
         if k is not None and k < updates.shape[0]:
             updates, mal_all, losses = updates[:k], mal_all[:k], losses[:k]
+        healthy = None
+        if fr.health_check:
+            from blades_tpu.core.health import sanitize_updates
+
+            updates, healthy = sanitize_updates(updates)
 
         if fr.adversary is not None and hasattr(fr.adversary, "on_updates_ready"):
             updates = fr.adversary.on_updates_ready(
@@ -169,6 +174,13 @@ def shard_map_step(fr: FedRound, mesh: Mesh) -> Callable:
             "agg_norm": jnp.linalg.norm(agg),
             "round": server.round,
         }
+        if fr.health_check:
+            from blades_tpu.core.health import guard_server_state
+
+            ok = jnp.isfinite(agg).all()
+            server = guard_server_state(ok, server, state.server)
+            metrics["num_unhealthy"] = (~healthy).sum()
+            metrics["round_ok"] = ok
         return RoundState(server=server, client_opt=client_opt), metrics
 
     return jax.jit(_step)

@@ -68,6 +68,7 @@ def streamed_step(
     client_block: int = 50,
     d_chunk: int = 1 << 17,
     update_dtype=jnp.bfloat16,
+    donate: bool = True,
 ) -> Callable:
     """Build the streaming round (a host-side callable over jitted parts).
 
@@ -82,6 +83,13 @@ def streamed_step(
         d_chunk: coordinates forged+aggregated per ``lax.scan`` iteration
             (bounds the f32 chunk + sort workspace).
         update_dtype: storage dtype of the ``(n, d)`` update matrix.
+        donate: when True (default), the caller's ``state.client_opt``
+            buffers are DONATED into the first training block — the memory
+            economy that lets the giant matrix fit, but the passed-in
+            state must not be reused afterwards (unlike
+            ``jax.jit(fr.step)``, which copies).  Pass False to keep the
+            caller's state alive at the cost of one opt-state copy per
+            round.
     """
     agg = fr.server.aggregator
     if not isinstance(agg, _COORDWISE_AGGREGATORS):
@@ -145,11 +153,16 @@ def streamed_step(
         starts = jnp.minimum(jnp.arange(k_chunks) * c, d - c)
 
         def chunk_body(carry, inp):
-            agg_vec, sq_acc = carry
+            agg_vec, sq_acc, bad_acc = carry
             i, start = inp
             chunk = lax.dynamic_slice(
                 updates_buf, (0, start), (n_eff, c)
             ).astype(jnp.float32)
+            if fr.health_check:
+                from blades_tpu.core.health import sanitize_updates
+
+                chunk, chunk_healthy = sanitize_updates(chunk)
+                bad_acc = bad_acc | ~chunk_healthy
             if forges:
                 chunk = fr.adversary.on_updates_ready(
                     chunk, malicious, jax.random.fold_in(k_adv, i),
@@ -160,11 +173,12 @@ def streamed_step(
             # Row-norm accumulation over not-yet-covered coordinates only.
             new = (start + jnp.arange(c)) >= i * c
             sq_acc = sq_acc + jnp.where(new[None, :], chunk**2, 0.0).sum(axis=1)
-            return (agg_vec, sq_acc), None
+            return (agg_vec, sq_acc, bad_acc), None
 
-        (agg_vec, sq_norms), _ = lax.scan(
+        (agg_vec, sq_norms, bad_rows), _ = lax.scan(
             chunk_body,
-            (jnp.zeros((d,), jnp.float32), jnp.zeros((n_eff,), jnp.float32)),
+            (jnp.zeros((d,), jnp.float32), jnp.zeros((n_eff,), jnp.float32),
+             jnp.zeros((n_eff,), bool)),
             (jnp.arange(k_chunks), starts),
         )
         server = fr.server.apply_aggregate(server_state, agg_vec)
@@ -176,6 +190,13 @@ def streamed_step(
             "agg_norm": jnp.linalg.norm(agg_vec),
             "round": server.round,
         }
+        if fr.health_check:
+            from blades_tpu.core.health import guard_server_state
+
+            ok = jnp.isfinite(agg_vec).all()
+            server = guard_server_state(ok, server, server_state)
+            metrics["num_unhealthy"] = bad_rows.sum()
+            metrics["round_ok"] = ok
         return server, metrics
 
     d_model = None  # resolved from params on first call
@@ -193,6 +214,8 @@ def streamed_step(
         train_keys = jax.random.split(k_train, n)
         updates_buf = jnp.zeros((n, d_model), update_dtype)
         client_opt = state.client_opt
+        if not donate:
+            client_opt = jax.tree.map(jnp.copy, client_opt)
         losses = []
         for b in range(n // client_block):
             updates_buf, client_opt, loss = _train_block(

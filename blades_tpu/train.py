@@ -35,6 +35,10 @@ def main(argv=None) -> int:
                         "train.py:154,228)")
     p_file.add_argument("--max-rounds", type=int, default=None,
                         help="override every experiment's training_iteration")
+    p_file.add_argument("--max-failures", type=int, default=0,
+                        help="retry a crashed trial from its latest "
+                        "checkpoint up to N times, then mark it failed and "
+                        "keep sweeping (Tune's trial fault tolerance)")
     p_file.add_argument("--coordinator", default=None, metavar="HOST:PORT",
                         help="multi-host bring-up via jax.distributed — the "
                         "TPU-native replacement for the reference's NCCL "
@@ -81,6 +85,7 @@ def main(argv=None) -> int:
                 checkpoint_score_attr=args.checkpoint_score_attr,
                 resume=args.resume,
                 max_rounds_override=args.max_rounds,
+                max_failures=args.max_failures,
             )
 
         if args.trace:

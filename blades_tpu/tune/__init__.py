@@ -10,6 +10,7 @@ visualization reads (ref: blades/tuned_examples/visualization/
 visualize.py:14-35).
 """
 
+from blades_tpu.tune.lanes import run_seed_lanes  # noqa: F401
 from blades_tpu.tune.sweep import (  # noqa: F401
     expand_grid,
     load_experiments_from_file,

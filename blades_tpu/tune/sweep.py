@@ -97,6 +97,19 @@ def _read_results(path: Path) -> List[Dict]:
     return rows
 
 
+def _truncate_results(path: Path, upto_round: int) -> None:
+    """Drop result rows past ``upto_round`` before appending a restored
+    run's rows — otherwise a restore from a checkpoint older than the last
+    written row would duplicate (and regress) ``training_iteration`` in
+    the line stream that visualization/resume consume."""
+    rows = _read_results(path)
+    kept = [r for r in rows if r.get("training_iteration", 0) <= upto_round]
+    if len(kept) != len(rows):
+        with open(path, "w") as f:
+            for r in kept:
+                f.write(json.dumps(r) + "\n")
+
+
 def _latest_checkpoint(tdir: Path) -> Optional[Path]:
     """Newest periodic checkpoint by round number (``ckpt_<round>``)."""
     ckpts = sorted(
@@ -134,6 +147,7 @@ def run_experiments(
     resume: bool = False,
     checkpoint_keep_num: Optional[int] = None,
     checkpoint_score_attr: str = "training_iteration",
+    max_failures: int = 0,
 ) -> List[Dict]:
     """Run every trial of every experiment sequentially; returns summaries.
 
@@ -147,6 +161,13 @@ def run_experiments(
     killed at any point picks up without redoing finished work.
     ``checkpoint_keep_num`` bounds on-disk checkpoints, keeping the best by
     ``checkpoint_score_attr`` (newest on ties).
+
+    ``max_failures`` is Tune's trial fault tolerance (the reference
+    inherits it via ``tune.run_experiments``, SURVEY.md §5): a trial that
+    raises is restarted from its latest periodic checkpoint up to
+    ``max_failures`` times (the error is appended to ``error.txt`` in the
+    trial dir); a trial that exhausts its retries is marked failed in the
+    summary and the REMAINING trials still run.
     """
     from blades_tpu.algorithms import get_algorithm_class
 
@@ -183,6 +204,7 @@ def run_experiments(
                 if ckpt is not None:
                     algo.load_checkpoint(str(ckpt))
                     resumed_from = algo.iteration
+                    _truncate_results(tdir / "result.json", algo.iteration)
             with open(tdir / "params.json", "w") as f:
                 json.dump(_jsonable(trial_cfg), f, indent=2, default=str)
             if verbose:
@@ -193,25 +215,59 @@ def run_experiments(
             t0 = time.perf_counter()
             start_round = algo.iteration
             ckpt_scores: Dict[str, float] = {}
-            mode = "a" if resumed_from else "w"
-            with open(tdir / "result.json", mode) as f:
-                # Stop on training_iteration (actual FL rounds), not train()
-                # calls — one call advances rounds_per_dispatch rounds.
-                while algo.iteration < max_rounds:
-                    result = algo.train()
-                    result["trial"] = tname
-                    f.write(json.dumps(_jsonable(result)) + "\n")
-                    best_acc = max(best_acc, result.get("test_acc", 0.0))
-                    if checkpoint_freq and algo.iteration % checkpoint_freq == 0:
-                        name = f"ckpt_{algo.iteration:06d}"
-                        algo.save_checkpoint(str(tdir / name))
-                        ckpt_scores[name] = float(
-                            result.get(checkpoint_score_attr, algo.iteration)
-                        )
-                        _prune_checkpoints(tdir, checkpoint_keep_num, ckpt_scores)
-                    if verbose > 1 and algo.iteration % 10 == 0:
-                        print(f"  round {algo.iteration}: {result}", flush=True)
-            if checkpoint_at_end:
+            failures = 0
+            failed_error = None
+            while True:
+                mode = "a" if (resumed_from or failures) else "w"
+                try:
+                    with open(tdir / "result.json", mode) as f:
+                        # Stop on training_iteration (actual FL rounds), not
+                        # train() calls — one call advances
+                        # rounds_per_dispatch rounds.
+                        while algo.iteration < max_rounds:
+                            result = algo.train()
+                            result["trial"] = tname
+                            f.write(json.dumps(_jsonable(result)) + "\n")
+                            best_acc = max(best_acc, result.get("test_acc", 0.0))
+                            if checkpoint_freq and algo.iteration % checkpoint_freq == 0:
+                                name = f"ckpt_{algo.iteration:06d}"
+                                algo.save_checkpoint(str(tdir / name))
+                                ckpt_scores[name] = float(
+                                    result.get(checkpoint_score_attr, algo.iteration)
+                                )
+                                _prune_checkpoints(tdir, checkpoint_keep_num, ckpt_scores)
+                            if verbose > 1 and algo.iteration % 10 == 0:
+                                print(f"  round {algo.iteration}: {result}", flush=True)
+                    break
+                except KeyboardInterrupt:
+                    raise
+                except Exception as exc:  # Tune's trial fault tolerance
+                    failures += 1
+                    import traceback
+
+                    with open(tdir / "error.txt", "a") as ef:
+                        ef.write(f"attempt {failures}: {exc!r}\n")
+                        ef.write(traceback.format_exc() + "\n")
+                    if failures > max_failures:
+                        failed_error = repr(exc)
+                        if verbose:
+                            print(f"   !! trial {tname} FAILED after "
+                                  f"{failures} attempt(s): {exc!r}", flush=True)
+                        break
+                    # Fresh build + restore from the latest checkpoint, the
+                    # reference's restart-from-checkpoint trial retry.
+                    _, config = get_algorithm_class(spec["run"], return_config=True)
+                    config.update_from_dict(trial_cfg)
+                    algo = config.build()
+                    ckpt = _latest_checkpoint(tdir)
+                    if ckpt is not None:
+                        algo.load_checkpoint(str(ckpt))
+                    _truncate_results(tdir / "result.json", algo.iteration)
+                    if verbose:
+                        print(f"   .. retrying {tname} from round "
+                              f"{algo.iteration} (failure {failures}/"
+                              f"{max_failures})", flush=True)
+            if checkpoint_at_end and failed_error is None:
                 algo.save_checkpoint(str(tdir / "ckpt_final"))
             wall = time.perf_counter() - t0
             new_rounds = algo.iteration - start_round
@@ -221,6 +277,9 @@ def run_experiments(
                 "best_test_acc": best_acc, "final": algo._last_eval,
                 "dir": str(tdir),
             }
+            if failed_error is not None:
+                summary["status"] = "ERROR"
+                summary["error"] = failed_error
             if resumed_from is not None:
                 summary["resumed"] = f"from round {resumed_from}"
             if verbose:

@@ -1,0 +1,201 @@
+"""Failure detection + elastic recovery tests (SURVEY.md §5).
+
+The reference's fault machinery is inherited from Ray
+(FaultTolerantActorManager, Tune trial retry — ref:
+fllib/core/execution/actor_manager.py:25, worker_group.py:95-127).  The
+TPU-native equivalents under test here (blades_tpu/core/health.py):
+lane-level detection/neutralisation inside the jitted round, round-level
+aggregate guards, and checkpoint-restart trial retry in the sweep runner.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from blades_tpu.core import FedRound, Server, TaskSpec
+from blades_tpu.core.health import guard_server_state, sanitize_updates
+
+
+def test_sanitize_updates_zeroes_nonfinite_lanes():
+    u = jnp.array([[1.0, 2.0], [jnp.nan, 3.0], [4.0, jnp.inf], [5.0, 6.0]])
+    clean, healthy = sanitize_updates(u)
+    assert healthy.tolist() == [True, False, False, True]
+    assert jnp.isfinite(clean).all()
+    assert clean[1].tolist() == [0.0, 3.0]  # only the bad entry zeroed
+    assert clean[2].tolist() == [4.0, 0.0]
+    assert jnp.array_equal(clean[0], u[0]) and jnp.array_equal(clean[3], u[3])
+
+
+def test_guard_server_state_keeps_params_advances_round():
+    server = Server.from_config(aggregator="Mean", lr=1.0)
+    task = TaskSpec(model="mlp", input_shape=(28, 28, 1)).build()
+    params = task.init_params(jax.random.PRNGKey(0))
+    old = server.init(params, num_clients=4)
+    new, _ = server.step(old, jnp.ones((4, sum(
+        p.size for p in jax.tree.leaves(params)))))
+    bad = guard_server_state(jnp.array(False), new, old)
+    assert int(bad.round) == 1  # the round happened
+    for a, b in zip(jax.tree.leaves(bad.params), jax.tree.leaves(old.params)):
+        assert jnp.array_equal(a, b)  # ...but the update was discarded
+    ok = guard_server_state(jnp.array(True), new, old)
+    for a, b in zip(jax.tree.leaves(ok.params), jax.tree.leaves(new.params)):
+        assert jnp.array_equal(a, b)
+
+
+@pytest.fixture(scope="module")
+def tiny_fr():
+    from blades_tpu.models import MLP
+
+    task = TaskSpec(model=MLP(hidden1=8, hidden2=8, num_classes=4),
+                    input_shape=(8, 8, 1), num_classes=4, lr=0.1).build()
+    server = Server.from_config(aggregator="Mean", lr=0.5)
+    fr = FedRound(task=task, server=server, batch_size=4,
+                  num_batches_per_round=1, health_check=True)
+    rng = np.random.default_rng(0)
+    n = 6
+    x = jnp.asarray(rng.normal(size=(n, 8, 8, 8, 1)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 4, size=(n, 8)), jnp.int32)
+    ln = jnp.full((n,), 8, jnp.int32)
+    state = fr.init(jax.random.PRNGKey(0), n)
+    return fr, state, x, y, ln
+
+
+def test_round_recovers_from_nan_client(tiny_fr):
+    """A client with a corrupt (NaN) shard is detected, neutralised, and
+    training continues — the lane-health analogue of marking an actor
+    unhealthy and routing around it."""
+    fr, state, x, y, ln = tiny_fr
+    x = x.at[2].set(jnp.nan)  # client 2's data is corrupt
+    mal = jnp.zeros(x.shape[0], bool)
+    step = jax.jit(fr.step)
+    new_state, m = step(state, x, y, ln, mal, jax.random.PRNGKey(1))
+    assert int(m["num_unhealthy"]) == 1
+    assert bool(m["round_ok"])
+    for p in jax.tree.leaves(new_state.server.params):
+        assert jnp.isfinite(p).all()
+    # And the model actually moved (the 5 healthy lanes still aggregated).
+    moved = any(
+        not jnp.array_equal(a, b)
+        for a, b in zip(jax.tree.leaves(new_state.server.params),
+                        jax.tree.leaves(state.server.params))
+    )
+    assert moved
+
+
+def test_round_guard_skips_nonfinite_aggregate(tiny_fr):
+    """If the aggregate itself is non-finite (here: a post-sanitize forging
+    adversary emitting inf), the server update is skipped — params survive
+    unchanged, the round counter still advances."""
+    from blades_tpu.adversaries import get_adversary
+
+    fr, state, x, y, ln = tiny_fr
+    n = x.shape[0]
+    adv = get_adversary("IPM", num_clients=n, num_byzantine=2, scale=float("inf"))
+    fr_bad = FedRound(task=fr.task, server=fr.server, adversary=adv,
+                      batch_size=4, num_batches_per_round=1, health_check=True)
+    mal = jnp.arange(n) < 2
+    step = jax.jit(fr_bad.step)
+    new_state, m = step(state, x, y, ln, mal, jax.random.PRNGKey(1))
+    assert not bool(m["round_ok"])
+    assert int(m["round"]) == int(state.server.round) + 1
+    for a, b in zip(jax.tree.leaves(new_state.server.params),
+                    jax.tree.leaves(state.server.params)):
+        assert jnp.array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# Sweep-level trial fault tolerance (Tune's max_failures).
+# ---------------------------------------------------------------------------
+
+
+class _FlakyConfig:
+    """Minimal config for a fake trainable (the reference registers mock
+    trainables for exactly this, ref: blades/algorithms/registry.py:37-48)."""
+
+    crash_state = {"remaining": 0}  # class-level: survives rebuilds
+
+    def update_from_dict(self, d):
+        self.cfg = d
+        return self
+
+    def build(self):
+        return _FlakyAlgo(self.cfg)
+
+
+class _FlakyAlgo:
+    def __init__(self, cfg):
+        self._iteration = 0
+        self._last_eval = {}
+        self.crash_at = cfg.get("crash_at", -1)
+
+    @property
+    def iteration(self):
+        return self._iteration
+
+    def train(self):
+        self._iteration += 1
+        if (self._iteration == self.crash_at
+                and _FlakyConfig.crash_state["remaining"] > 0):
+            _FlakyConfig.crash_state["remaining"] -= 1
+            raise RuntimeError("injected fault")
+        return {"training_iteration": self._iteration, "test_acc": 0.5}
+
+    def save_checkpoint(self, d):
+        import pathlib
+
+        p = pathlib.Path(d)
+        p.mkdir(parents=True, exist_ok=True)
+        (p / "it.json").write_text(json.dumps({"it": self._iteration}))
+        return d
+
+    def load_checkpoint(self, path):
+        import pathlib
+
+        self._iteration = json.loads(
+            (pathlib.Path(path) / "it.json").read_text())["it"]
+
+
+@pytest.fixture()
+def flaky_registry():
+    from blades_tpu.algorithms import registry
+
+    registry.ALGORITHMS["FLAKY"] = lambda: (_FlakyAlgo, _FlakyConfig)
+    yield
+    registry.ALGORITHMS.pop("FLAKY", None)
+
+
+def test_sweep_retries_failed_trial_from_checkpoint(tmp_path, flaky_registry):
+    from blades_tpu.tune import run_experiments
+
+    _FlakyConfig.crash_state["remaining"] = 1  # crash once, then heal
+    experiments = {"exp": {"run": "FLAKY", "stop": {"training_iteration": 8},
+                           "config": {"crash_at": 5}}}
+    summaries = run_experiments(
+        experiments, storage_path=str(tmp_path), verbose=0,
+        checkpoint_freq=2, max_failures=2,
+    )
+    (s,) = summaries
+    assert "status" not in s  # recovered, not failed
+    assert s["rounds"] == 8
+    err = tmp_path / "exp" / "exp_00000" / "error.txt"
+    assert err.exists() and "injected fault" in err.read_text()
+
+
+def test_sweep_marks_trial_failed_and_continues(tmp_path, flaky_registry):
+    from blades_tpu.tune import run_experiments
+
+    _FlakyConfig.crash_state["remaining"] = 10  # crashes forever
+    experiments = {"exp": {"run": "FLAKY", "stop": {"training_iteration": 8},
+                           "config": {"crash_at": {"grid_search": [3, -1]}}}}
+    summaries = run_experiments(
+        experiments, storage_path=str(tmp_path), verbose=0, max_failures=1,
+    )
+    assert len(summaries) == 2
+    assert summaries[0].get("status") == "ERROR"
+    assert "injected fault" in summaries[0]["error"]
+    # The second trial (crash_at=-1, never crashes) still ran to completion.
+    assert "status" not in summaries[1]
+    assert summaries[1]["rounds"] == 8

@@ -56,3 +56,42 @@ def test_models_are_pure_no_mutable_collections():
     m = ModelCatalog.get_model("resnet10")
     variables = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
     assert set(variables.keys()) == {"params"}
+
+
+def test_cct_cvt_variant_zoo():
+    """The full named variant surface (ref: cctnets/cct.py:203-658,
+    cvt.py:138-321): every 32x32 variant builds and runs forward."""
+    from blades_tpu.models.cct import CCT, CVT, VARIANTS
+
+    # Name surface parity with the reference zoo.
+    for name in ["cct_2_3x2_32", "cct_4_3x2_32", "cct_6_3x1_32",
+                 "cct_6_3x2_32", "cct_7_3x1_32", "cct_7_3x2_32",
+                 "cct_7_7x2_224", "cct_14_7x2_224", "cct_14_7x2_384",
+                 "cvt_2_4_32", "cvt_7_4_32"]:
+        assert name in VARIANTS, name
+        assert f"{name}_sine" in VARIANTS, name
+    assert "cct_7_3x1_32_c100" in VARIANTS
+    assert "cct_7_3x1_32_sine_c100" in VARIANTS
+
+    x = jnp.zeros((2, 32, 32, 3))
+    for name in ["cct_6_3x2_32", "cct_7_3x2_32_sine", "cvt_2_4_32",
+                 "cvt_6_4_32_sine"]:
+        m = VARIANTS[name]()
+        assert isinstance(m, (CCT, CVT))
+        params = m.init(jax.random.PRNGKey(0), x)
+        assert m.apply(params, x).shape == (2, 10)
+
+    # c100 preset defaults to 100 classes.
+    m = VARIANTS["cct_7_3x1_32_c100"]()
+    params = m.init(jax.random.PRNGKey(0), x)
+    assert m.apply(params, x).shape == (2, 100)
+
+
+def test_catalog_resolves_named_cct_variants():
+    from blades_tpu.models.cct import CVT
+
+    m = ModelCatalog.get_model("cvt_2_4_32", num_classes=7)
+    assert isinstance(m, CVT)
+    x = jnp.zeros((1, 32, 32, 3))
+    params = m.init(jax.random.PRNGKey(0), x)
+    assert m.apply(params, x).shape == (1, 7)
