@@ -133,14 +133,14 @@ class Fedavg:
         default) + periodic eval, returns the last round's result dict."""
         round_key, self._key = jax.random.split(self._key)
         with self.timers.time("training_step"):
-            self.state, metrics = self._step(
+            self.state, raw_metrics = self._step(
                 self.state, *self._train_arrays, self.malicious, round_key
             )
             # Concrete fetches inside the timer: block_until_ready alone can
             # return early through remote-execution tunnels.
             metrics = {
                 k: float(v[-1] if getattr(v, "ndim", 0) else v)
-                for k, v in metrics.items()
+                for k, v in raw_metrics.items()
             }
         self._iteration += self._chunk
         self._rounds_since_eval += self._chunk
@@ -152,8 +152,11 @@ class Fedavg:
             "timers": self.timers.summary(),
         }
         if self.config.health_check:  # failure-detection metrics (health.py)
-            result["num_unhealthy"] = int(metrics["num_unhealthy"])
-            result["round_ok"] = bool(metrics["round_ok"])
+            # Reduce over the dispatch chunk, not just its last round: a
+            # bad round mid-chunk must surface (sum of per-round unhealthy
+            # lane counts; ok only if EVERY round was ok).
+            result["num_unhealthy"] = int(jnp.sum(raw_metrics["num_unhealthy"]))
+            result["round_ok"] = bool(jnp.all(raw_metrics["round_ok"]))
         # Rounds-since-last-eval cadence: robust to rounds_per_dispatch not
         # dividing evaluation_interval (a modulo test would then never fire).
         if self.config.evaluation_interval and (

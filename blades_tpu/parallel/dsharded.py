@@ -323,6 +323,16 @@ def dsharded_step(fr: FedRound, mesh: Mesh) -> Callable:
         if k is not None and k < upd_shard.shape[0]:
             upd_shard, mal_all, losses = upd_shard[:k], mal_all[:k], losses[:k]
 
+        healthy = None
+        if fr.health_check:
+            # Row health over the FULL width: a lane is unhealthy if any
+            # of its shards holds a non-finite value — one psum of the
+            # per-shard verdicts, then the whole row is zeroed everywhere
+            # (same semantics as core.health.sanitize_updates).
+            local_bad = ~jnp.isfinite(upd_shard).all(axis=1)
+            healthy = shard.psum(local_bad.astype(jnp.int32)) == 0
+            upd_shard = jnp.where(healthy[:, None], upd_shard, 0.0)
+
         if adv_forges:
             upd_shard = fr.adversary.on_updates_ready(
                 upd_shard, mal_all, k_adv,
@@ -359,6 +369,14 @@ def dsharded_step(fr: FedRound, mesh: Mesh) -> Callable:
             "agg_norm": jnp.linalg.norm(agg),
             "round": server.round,
         }
+        if fr.health_check:
+            from blades_tpu.core.health import guard_server_state
+
+            # agg is already the replicated full (d,) vector.
+            ok = jnp.isfinite(agg).all()
+            server = guard_server_state(ok, server, state.server)
+            metrics["num_unhealthy"] = (~healthy).sum()
+            metrics["round_ok"] = ok
         return RoundState(server=server, client_opt=client_opt), metrics
 
     return jax.jit(_step)

@@ -161,6 +161,11 @@ def streamed_step(
             if fr.health_check:
                 from blades_tpu.core.health import sanitize_updates
 
+                # Chunk-local detection: a lane non-finite only in LATER
+                # chunks keeps its earlier finite chunk parts (zeroing
+                # them would need a second full pass over the matrix).
+                # num_unhealthy still counts the lane; the kept parts are
+                # finite, so the aggregate guard semantics are unchanged.
                 chunk, chunk_healthy = sanitize_updates(chunk)
                 bad_acc = bad_acc | ~chunk_healthy
             if forges:

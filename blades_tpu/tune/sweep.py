@@ -181,6 +181,14 @@ def run_experiments(
             tname = _trial_name(exp_name, i, trial_cfg)
             tdir = root / exp_name / tname
             tdir.mkdir(parents=True, exist_ok=True)
+            if not resume:
+                # Fresh run: clear checkpoints left by a previous sweep in
+                # the same storage path, or a transient-crash retry would
+                # restore a STALE run's state and skip this run's rounds.
+                import shutil
+
+                for p in tdir.glob("ckpt_*"):
+                    shutil.rmtree(p, ignore_errors=True)
             prior = _read_results(tdir / "result.json") if resume else []
             best_acc = max((r.get("test_acc", 0.0) for r in prior), default=0.0)
             done = prior[-1].get("training_iteration", 0) if prior else 0

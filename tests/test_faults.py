@@ -24,8 +24,10 @@ def test_sanitize_updates_zeroes_nonfinite_lanes():
     clean, healthy = sanitize_updates(u)
     assert healthy.tolist() == [True, False, False, True]
     assert jnp.isfinite(clean).all()
-    assert clean[1].tolist() == [0.0, 3.0]  # only the bad entry zeroed
-    assert clean[2].tolist() == [4.0, 0.0]
+    # The WHOLE unhealthy lane is zeroed — its finite entries came from
+    # the same diverged run and would still poison a Mean.
+    assert clean[1].tolist() == [0.0, 0.0]
+    assert clean[2].tolist() == [0.0, 0.0]
     assert jnp.array_equal(clean[0], u[0]) and jnp.array_equal(clean[3], u[3])
 
 
@@ -199,3 +201,41 @@ def test_sweep_marks_trial_failed_and_continues(tmp_path, flaky_registry):
     # The second trial (crash_at=-1, never crashes) still ran to completion.
     assert "status" not in summaries[1]
     assert summaries[1]["rounds"] == 8
+
+
+def test_dsharded_health_check_detects_and_recovers():
+    """Cross-shard row health on the width-sharded giant-federation path:
+    a NaN client lane is detected via psum over its shards, zeroed, and
+    the round still updates the model (SURVEY.md §5 failure detection on
+    the multi-chip production path)."""
+    import dataclasses
+
+    import jax
+
+    from blades_tpu.adversaries import make_malicious_mask
+    from blades_tpu.data import DatasetCatalog
+    from blades_tpu.parallel import make_mesh
+    from blades_tpu.parallel.dsharded import dsharded_step
+
+    n = 16
+    ds = DatasetCatalog.get_dataset("mnist", num_clients=n)
+    task = TaskSpec(model="mlp", lr=0.1, input_shape=(28, 28, 1)).build()
+    server = Server.from_config(aggregator="Mean", lr=1.0)
+    fr = FedRound(task=task, server=server, batch_size=8, health_check=True)
+    x = jnp.array(ds.train.x).at[5].set(jnp.nan)  # client 5's shard corrupt
+    y, ln = jnp.array(ds.train.y), jnp.array(ds.train.lengths)
+    mal = make_malicious_mask(n, 0)
+    mesh = make_mesh()
+    state = fr.init(jax.random.PRNGKey(0), n)
+    step = dsharded_step(fr, mesh)
+    new_state, m = step(state, x, y, ln, mal, jax.random.PRNGKey(1))
+    assert int(m["num_unhealthy"]) == 1
+    assert bool(m["round_ok"])
+    for p in jax.tree.leaves(new_state.server.params):
+        assert jnp.isfinite(p).all()
+    moved = any(
+        not jnp.array_equal(a, b)
+        for a, b in zip(jax.tree.leaves(new_state.server.params),
+                        jax.tree.leaves(state.server.params))
+    )
+    assert moved

@@ -1,0 +1,65 @@
+"""Pallas rank-select kernel tests (interpret mode on the CPU mesh).
+
+The TPU kernels must match the jnp.sort-based paths bit-for-bit for the
+median and to f32 accumulation tolerance for the trimmed mean — including
+duplicate values, non-sublane-aligned client counts (row padding), odd
+column counts (column padding), and +/-inf entries.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from blades_tpu.ops import masked
+from blades_tpu.ops import pallas_select as ps
+
+
+def _matrix(n, d, seed=0, dupes=True):
+    rng = np.random.default_rng(seed)
+    x = (rng.normal(size=(n, d)) * 10).astype(np.float32)
+    if dupes:
+        x[: n // 3] = np.round(x[: n // 3])  # force ties
+        x[0] = x[-1]
+    return x
+
+
+@pytest.mark.parametrize("n", [7, 8, 25, 100])
+@pytest.mark.parametrize("d", [5, 128, 300])
+def test_column_median_matches_sort_path_exactly(n, d):
+    x = _matrix(n, d, seed=n * 1000 + d)
+    got = ps.column_median(jnp.asarray(x), interpret=True)
+    want = masked.median(jnp.asarray(x))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_column_median_with_infs():
+    x = _matrix(10, 64, seed=3)
+    x[0, :] = np.inf
+    x[1, :8] = -np.inf
+    got = ps.column_median(jnp.asarray(x), interpret=True)
+    want = masked.median(jnp.asarray(x))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+@pytest.mark.parametrize("n,k", [(9, 1), (16, 3), (100, 10)])
+def test_column_trimmed_mean_matches_sort_path(n, k):
+    x = _matrix(n, 200, seed=n)
+    got = ps.column_trimmed_mean(jnp.asarray(x), k, interpret=True)
+    s = np.sort(x, axis=0)
+    want = s[k : n - k].mean(axis=0)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-5, atol=2e-5)
+
+
+def test_column_trimmed_mean_all_ties():
+    # Whole retained window one duplicate run: the vlo==vhi guard.
+    x = np.ones((12, 130), np.float32) * 2.5
+    x[0] = -100.0
+    x[-1] = 100.0
+    got = ps.column_trimmed_mean(jnp.asarray(x), 2, interpret=True)
+    np.testing.assert_array_equal(np.asarray(got), np.full(130, 2.5, np.float32))
+
+
+def test_should_use_is_conservative_on_cpu():
+    # CPU backend (the test mesh): never routes to pallas, so the
+    # aggregator tests exercise the jnp paths unchanged.
+    assert not ps.should_use(jnp.zeros((1000, 8192), jnp.float32))
