@@ -74,6 +74,15 @@ class FedavgConfig:
         self.compute_dtype: Any = None
         # rounds fused per device dispatch (lax.scan); 1 = round-per-call
         self.rounds_per_dispatch: int = 1
+        # execution path: "auto" | "dense" | "streamed".  "streamed" runs
+        # the single-chip streaming round (parallel/streamed.py) whose
+        # bf16 (n, d) update matrix + block dispatches fit giant
+        # federations in one chip's HBM; "auto" picks it when the dense
+        # f32 matrix would strain HBM (> ~6 GB) and no mesh is requested.
+        self.execution: str = "auto"
+        self.client_block: int = 50        # clients per streamed dispatch
+        self.d_chunk: int = 1 << 17        # coords per streamed agg chunk
+        self.update_dtype: str = "bfloat16"  # streamed matrix storage
         # failure detection / elastic recovery (core/health.py): zero
         # non-finite client lanes, skip non-finite server updates
         self.health_check: bool = False
@@ -126,8 +135,11 @@ class FedavgConfig:
     def evaluation(self, *, evaluation_interval=None):
         return self._set(evaluation_interval=evaluation_interval)
 
-    def resources(self, *, num_devices=None):
-        return self._set(num_devices=num_devices)
+    def resources(self, *, num_devices=None, execution=None, client_block=None,
+                  d_chunk=None, update_dtype=None):
+        return self._set(num_devices=num_devices, execution=execution,
+                         client_block=client_block, d_chunk=d_chunk,
+                         update_dtype=update_dtype)
 
     def fault_tolerance(self, *, health_check=None):
         """In-round failure detection / elastic recovery (core/health.py);
@@ -217,6 +229,30 @@ class FedavgConfig:
         # default num_classes (a 10-way head on CIFAR-100 is never right).
         if name in _NUM_CLASSES and self.num_classes == 10:
             self.num_classes = _NUM_CLASSES[name]
+        if self.execution not in ("auto", "dense", "streamed"):
+            raise ValueError(
+                f"execution must be auto|dense|streamed, got {self.execution!r}"
+            )
+        if self.execution == "streamed":
+            if self.num_devices and self.num_devices > 1:
+                raise ValueError(
+                    "execution='streamed' is the single-chip giant-federation "
+                    "path; use the mesh (num_devices>1) for multi-chip"
+                )
+            if self.rounds_per_dispatch > 1:
+                raise ValueError(
+                    "execution='streamed' dispatches per client block; "
+                    "rounds_per_dispatch must be 1"
+                )
+        if str(self.update_dtype) not in ("bfloat16", "float32"):
+            raise ValueError(
+                f"update_dtype must be 'bfloat16' or 'float32', got "
+                f"{self.update_dtype!r}"
+            )
+        if self.d_chunk < 1024:
+            raise ValueError(f"d_chunk must be >= 1024, got {self.d_chunk}")
+        if self.client_block < 1:
+            raise ValueError(f"client_block must be >= 1, got {self.client_block}")
 
     def freeze(self) -> None:
         self._frozen = True

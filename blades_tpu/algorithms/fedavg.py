@@ -87,6 +87,24 @@ class Fedavg:
             else:
                 self._step = sharded_step(self.fed_round, self.mesh, donate=False)
             self._evaluate = sharded_evaluate(self.fed_round, self.mesh)
+        elif self._use_streamed():
+            from blades_tpu.parallel.streamed import streamed_step
+
+            # With bf16 compute the loss casts inputs down anyway — store
+            # the resident training images in bf16 and halve their HBM
+            # footprint (2.4 GB -> 1.2 GB at 1000 CIFAR clients), which
+            # the giant bf16 update matrix needs back.
+            cd = self.fed_round.task.spec.compute_dtype
+            if cd is not None:
+                x, y, ln = self._train_arrays
+                self._train_arrays = (x.astype(jnp.dtype(cd)), y, ln)
+            self._step = streamed_step(
+                self.fed_round,
+                client_block=self._streamed_block(),
+                d_chunk=cfg.d_chunk,
+                update_dtype=getattr(jnp, str(cfg.update_dtype)),
+            )
+            self._evaluate = jax.jit(self.fed_round.evaluate)
         else:
             if self._chunk > 1:
                 from functools import partial
@@ -102,6 +120,47 @@ class Fedavg:
         self._iteration = 0
         self._rounds_since_eval = 0
         self._last_eval: Dict = {}
+
+    def _use_streamed(self) -> bool:
+        """Pick the single-chip streaming round (parallel/streamed.py).
+
+        Explicit ``execution='streamed'`` always; ``'auto'`` when the
+        dense f32 ``(n, d)`` update matrix would strain a 16 GB chip's
+        HBM (> ~6 GB) — the giant-federation regime the streamed path
+        exists for."""
+        cfg = self.config
+        if cfg.execution == "dense":
+            return False
+        if cfg.execution == "streamed":
+            return True
+        if self._chunk > 1:
+            return False  # multi-round fusion needs the dense program
+        from blades_tpu.parallel.streamed import (
+            _COORDWISE_AGGREGATORS,
+            _COORDWISE_FORGERS,
+            _adv_forges,
+        )
+
+        fr = self.fed_round
+        if not isinstance(fr.server.aggregator, _COORDWISE_AGGREGATORS):
+            return False
+        if _adv_forges(fr.adversary) and not isinstance(
+            fr.adversary, _COORDWISE_FORGERS
+        ):
+            return False
+        if fr.dp_clip_threshold is not None:
+            return False
+        d = sum(p.size for p in jax.tree.leaves(self.state.server.params))
+        return cfg.num_clients * d * 4 > 6 * (1 << 30)
+
+    def _streamed_block(self) -> int:
+        """Largest divisor of num_clients that is <= the configured
+        client_block (the streamed path needs an exact tiling)."""
+        n, want = self.config.num_clients, max(1, self.config.client_block)
+        for b in range(min(want, n), 0, -1):
+            if n % b == 0:
+                return b
+        return 1
 
     def _attach_root_data(self, fed_round: FedRound) -> FedRound:
         """Carve a clean server root dataset for FLTrust (Cao et al.): a few

@@ -202,6 +202,11 @@ def _build_image_dataset(
     if synthetic:
         # Process-stable, caller-seed-dependent (str hash is randomized).
         synth_seed = (zlib.crc32(name.encode()) ^ (seed * 0x9E3779B1)) % (2**31)
+        # Giant federations: keep the real datasets' per-client density
+        # (~50 train / ~10 test rows per client at n=1000 on CIFAR-10)
+        # instead of starving 1000 clients on a fixed 5000-sample stand-in.
+        synth_train = max(synth_train, num_clients * 50)
+        synth_test = max(synth_test, num_clients * 10)
         tx, ty, vx, vy = _synthetic_classification(
             synth_train, synth_test, input_shape, num_classes, seed=synth_seed
         )

@@ -71,12 +71,32 @@ def dirichlet_partition(
     Re-draws the whole partition until every client holds at least
     ``min_size`` samples — the reference's rejection loop
     (ref: fldataset.py:177-196).  Lower ``alpha`` = more skew.
+
+    At giant-federation scale the rejection loop is hopeless: with ~50
+    samples/client and alpha=0.1 a draw where all 1000 clients clear
+    min_size=10 essentially never happens (the reference only ever ran 60
+    clients).  After a bounded number of redraws the last draw is
+    REPAIRED instead: starved clients take rows from the largest shards
+    (never dragging a donor below ``min_size``), preserving the drawn
+    skew everywhere else.  Deterministic per seed.
     """
     labels = np.asarray(labels)
     num_samples = labels.shape[0]
+    if num_samples < num_clients * min_size:
+        raise ValueError(
+            f"{num_samples} samples cannot give {num_clients} clients "
+            f"min_size={min_size} each"
+        )
     classes = np.unique(labels)
     rng = np.random.default_rng(seed)
-    for _ in range(max_tries):
+    # Rejection redraws are cheap at canonical scales (60 clients x 800+
+    # samples: the first draw virtually always clears min_size) and futile
+    # at giant ones (1000 clients x 50 samples: no draw ever does, and
+    # 1000 doomed redraws cost ~25 s).  Bound redraws unless samples are
+    # plentiful enough that rejection is the expected exit.
+    tries = max_tries if num_samples // num_clients >= 10 * min_size else 20
+    shards: list[np.ndarray] = []
+    for _ in range(tries):
         idx_per_client: list[list[np.ndarray]] = [[] for _ in range(num_clients)]
         for c in classes:
             idx_c = np.where(labels == c)[0]
@@ -96,10 +116,24 @@ def dirichlet_partition(
         shards = [np.sort(np.concatenate(p)) for p in idx_per_client]
         if min(len(s) for s in shards) >= min_size:
             return shards
-    raise RuntimeError(
-        f"dirichlet_partition failed to satisfy min_size={min_size} in "
-        f"{max_tries} tries (alpha={alpha}, num_clients={num_clients})"
-    )
+    # Repair the final draw: move rows from the largest shards into the
+    # starved ones.
+    sizes = np.array([len(s) for s in shards])
+    while sizes.min() < min_size:
+        small = int(sizes.argmin())
+        big = int(sizes.argmax())
+        need = min(min_size - sizes[small], sizes[big] - min_size)
+        if need <= 0:
+            break  # donors exhausted (can't happen given the total check)
+        donor = shards[big]
+        give = rng.choice(len(donor), size=need, replace=False)
+        keep = np.ones(len(donor), dtype=bool)
+        keep[give] = False
+        shards[small] = np.sort(np.concatenate([shards[small], donor[give]]))
+        shards[big] = donor[keep]
+        sizes[small] += need
+        sizes[big] -= need
+    return shards
 
 
 def partition_dataset(

@@ -12,9 +12,10 @@ full-height ``(n, block_d)`` column stripe into VMEM and computes exact
 order statistics in-core via binary bit-search over monotone uint32 keys
 (the classic radix-select): for each of 32 bits, count how many keys fall
 below the candidate prefix — O(32·n) VPU compares per column, no data
-movement.  Exactness matches ``jnp.sort``-based selection bit-for-bit
-(same IEEE total order on finite floats; NaNs map above +inf so
-health-sanitized input is unaffected).
+movement.  Exactness matches ``jnp.sort``-based selection bit-for-bit on
+non-NaN data; NaNs of either sign are mapped to the maximum key, matching
+jnp.sort's NaN-last ORDER exactly (a selected NaN comes back canonical
+rather than payload-preserving).
 
 Used by :class:`blades_tpu.ops.aggregators.Median` / ``Trimmedmean`` when
 running on a TPU backend with a large matrix, and directly by the
@@ -53,17 +54,23 @@ def should_use(x: jax.Array) -> bool:
         backend == "tpu"
         and x.dtype == jnp.float32
         and x.ndim == 2
-        and x.shape[0] >= 8
+        # Full-height column stripes must fit VMEM: (n, 512) f32 values +
+        # uint32 keys ≈ n * 4 KiB, so cap n well under the ~16 MiB budget.
+        and 8 <= x.shape[0] <= 2048
         and x.shape[0] * x.shape[1] >= (1 << 22)
     )
 
 
 def _keys_of(x):
-    """Monotone f32 -> uint32 map: order of keys == IEEE total order of
-    floats (negatives flipped entirely, positives offset past them)."""
+    """Monotone f32 -> uint32 map: order of keys == IEEE order of floats
+    (negatives flipped entirely, positives offset past them).  ALL NaNs —
+    either sign — map to the maximum key, matching ``jnp.sort``'s
+    NaN-last semantics (a raw sign-bit NaN would otherwise sort first and
+    shift every selected rank)."""
     b = jax.lax.bitcast_convert_type(x, jnp.uint32)
     neg = (b >> 31) == 1
-    return jnp.where(neg, ~b, b | jnp.uint32(0x80000000))
+    key = jnp.where(neg, ~b, b | jnp.uint32(0x80000000))
+    return jnp.where(jnp.isnan(x), jnp.uint32(0xFFFFFFFF), key)
 
 
 def _vals_of(k):

@@ -63,3 +63,26 @@ def test_should_use_is_conservative_on_cpu():
     # CPU backend (the test mesh): never routes to pallas, so the
     # aggregator tests exercise the jnp paths unchanged.
     assert not ps.should_use(jnp.zeros((1000, 8192), jnp.float32))
+
+
+def test_column_median_negative_nan_matches_sort_order():
+    """Sign-bit NaNs must follow jnp.sort's NaN-LAST order (a raw key map
+    would sort them first and shift every selected rank)."""
+    x = _matrix(9, 64, seed=11)
+    neg_nan = np.uint32(0xFFC00000).view(np.float32)
+    x[0, :16] = neg_nan
+    x[1, :8] = np.nan
+    got = ps.column_median(jnp.asarray(x), interpret=True)
+    want = masked.median(jnp.asarray(x))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_should_use_caps_client_count(monkeypatch):
+    """Even on a TPU backend, a federation too tall for the full-height
+    VMEM stripe must fall back to the sort path."""
+    import jax
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    assert ps.should_use(jnp.zeros((1000, 8192), jnp.float32))
+    assert not ps.should_use(jnp.zeros((4096, 4096), jnp.float32))
+    assert not ps.should_use(jnp.zeros((4, 1 << 21), jnp.float32))

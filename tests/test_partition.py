@@ -101,3 +101,25 @@ def test_random_crop_flip_augmentation():
     assert out.max() <= x.max()
     assert get_augmentation("cifar") is random_crop_flip
     assert get_augmentation(None) is None
+
+
+def test_dirichlet_partition_giant_federation_repair():
+    """1000 clients x ~50 samples at alpha=0.1: rejection sampling cannot
+    clear min_size, so the repair path must — every client >= 10 rows,
+    full coverage, no duplicates, deterministic per seed."""
+    rng = np.random.default_rng(0)
+    y = rng.integers(0, 10, size=50_000)
+    shards = dirichlet_partition(y, 1000, alpha=0.1, seed=3)
+    sizes = [len(s) for s in shards]
+    assert min(sizes) >= 10
+    allidx = np.concatenate(shards)
+    assert len(allidx) == 50_000 and len(np.unique(allidx)) == 50_000
+    shards2 = dirichlet_partition(y, 1000, alpha=0.1, seed=3)
+    for a, b in zip(shards, shards2):
+        np.testing.assert_array_equal(np.sort(a), np.sort(b))
+
+
+def test_dirichlet_partition_impossible_raises():
+    y = np.zeros(50, dtype=int)
+    with pytest.raises(ValueError, match="min_size"):
+        dirichlet_partition(y, 10, alpha=0.1, seed=0)
