@@ -65,6 +65,10 @@ class FedavgConfig:
         self.adversary_config: Optional[Dict] = None
         # evaluation (ref: algorithm_config.py evaluation_interval)
         self.evaluation_interval: int = 50
+        # cap on test rows evaluated PER CLIENT (None = the full per-client
+        # test shard).  At 1000 clients the full sharded test set doubles
+        # device memory and eval cost for little metric benefit.
+        self.evaluation_num_samples: Optional[int] = None
         # dp (ref: blades/clients/dp_client.py) — set via FedavgDPConfig
         self.dp_clip_threshold: Optional[float] = None
         self.dp_noise_factor: Optional[float] = None
@@ -132,8 +136,9 @@ class FedavgConfig:
         return self._set(num_malicious_clients=num_malicious_clients,
                          adversary_config=adversary_config)
 
-    def evaluation(self, *, evaluation_interval=None):
-        return self._set(evaluation_interval=evaluation_interval)
+    def evaluation(self, *, evaluation_interval=None, num_samples=None):
+        return self._set(evaluation_interval=evaluation_interval,
+                         evaluation_num_samples=num_samples)
 
     def resources(self, *, num_devices=None, execution=None, client_block=None,
                   d_chunk=None, update_dtype=None):
@@ -229,10 +234,22 @@ class FedavgConfig:
         # default num_classes (a 10-way head on CIFAR-100 is never right).
         if name in _NUM_CLASSES and self.num_classes == 10:
             self.num_classes = _NUM_CLASSES[name]
-        if self.execution not in ("auto", "dense", "streamed"):
+        if self.execution not in ("auto", "dense", "streamed", "dsharded"):
             raise ValueError(
-                f"execution must be auto|dense|streamed, got {self.execution!r}"
+                "execution must be auto|dense|streamed|dsharded, got "
+                f"{self.execution!r}"
             )
+        if self.execution == "dsharded":
+            if not self.num_devices or self.num_devices < 2:
+                raise ValueError(
+                    "execution='dsharded' width-shards the update matrix "
+                    "over a mesh; set .resources(num_devices=...) > 1"
+                )
+            if self.rounds_per_dispatch > 1:
+                raise ValueError(
+                    "execution='dsharded' is a single-round program; "
+                    "rounds_per_dispatch must be 1"
+                )
         if self.execution == "streamed":
             if self.num_devices and self.num_devices > 1:
                 raise ValueError(
@@ -253,6 +270,11 @@ class FedavgConfig:
             raise ValueError(f"d_chunk must be >= 1024, got {self.d_chunk}")
         if self.client_block < 1:
             raise ValueError(f"client_block must be >= 1, got {self.client_block}")
+        if self.evaluation_num_samples is not None and self.evaluation_num_samples < 1:
+            raise ValueError(
+                f"evaluation_num_samples must be >= 1 (or None for the full "
+                f"per-client shard), got {self.evaluation_num_samples}"
+            )
 
     def freeze(self) -> None:
         self._frozen = True

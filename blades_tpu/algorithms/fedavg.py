@@ -60,11 +60,32 @@ class Fedavg:
             jnp.asarray(self.dataset.train.y),
             jnp.asarray(self.dataset.train.lengths),
         )
-        self._test_arrays = (
-            jnp.asarray(self.dataset.test.x),
-            jnp.asarray(self.dataset.test.y),
-            jnp.asarray(self.dataset.test.lengths),
-        )
+        tx = jnp.asarray(self.dataset.test.x)
+        ty = jnp.asarray(self.dataset.test.y)
+        tln = jnp.asarray(self.dataset.test.lengths)
+        cap = cfg.evaluation_num_samples
+        if cap is not None and cap < tx.shape[1]:
+            # Per-client eval subsample: bounds device memory + eval cost
+            # at giant scale.  Shard rows are index-SORTED (partition.py
+            # returns np.sort-ed indices), so taking the first rows would
+            # bias any non-randomly-ordered test set — draw a seeded
+            # random subset of each client's true rows instead.
+            import numpy as np
+
+            rng = np.random.default_rng(cfg.seed ^ 0x5EED)
+            n = tx.shape[0]
+            pick = np.zeros((n, cap), np.int32)
+            for i in range(n):
+                k = int(tln[i])
+                pick[i] = (rng.choice(k, size=cap, replace=False)
+                           if k >= cap else np.arange(cap) % max(k, 1))
+            tx = jnp.take_along_axis(
+                tx, jnp.asarray(pick).reshape((n, cap) + (1,) * (tx.ndim - 2)),
+                axis=1,
+            )
+            ty = jnp.take_along_axis(ty, jnp.asarray(pick), axis=1)
+            tln = jnp.minimum(tln, cap)
+        self._test_arrays = (tx, ty, tln)
 
         self._chunk = max(1, int(getattr(cfg, "rounds_per_dispatch", 1)))
         self.mesh = None
@@ -80,7 +101,15 @@ class Fedavg:
             _, self._test_arrays = shard_federation(
                 self.mesh, self.state, self._test_arrays
             )
-            if self._chunk > 1:
+            if cfg.execution == "dsharded" or (
+                cfg.execution == "auto" and self._dsharded_auto()
+            ):
+                from blades_tpu.parallel.dsharded import dsharded_step
+
+                # Width-sharded giant-federation round: per-device memory
+                # is n*d/n_dev — the (n, d) matrix never exists anywhere.
+                self._step = dsharded_step(self.fed_round, self.mesh)
+            elif self._chunk > 1:
                 self._step = sharded_multi_step(
                     self.fed_round, self.mesh, self._chunk, donate=False
                 )
@@ -121,6 +150,24 @@ class Fedavg:
         self._rounds_since_eval = 0
         self._last_eval: Dict = {}
 
+    # A dense f32 (n, d) update matrix past this strains one 16 GB chip's
+    # HBM once training temps and data join it — the giant-federation
+    # regime both memory-economical paths exist for.
+    _DENSE_MATRIX_HBM_LIMIT = 6 * (1 << 30)
+
+    def _dense_matrix_bytes(self) -> int:
+        d = sum(p.size for p in jax.tree.leaves(self.state.server.params))
+        return self.config.num_clients * d * 4
+
+    def _dsharded_auto(self) -> bool:
+        """On a mesh, pick the width-sharded round when the replicated
+        (n, d) matrix the gather formulations materialise per device would
+        strain HBM; also requires rounds_per_dispatch=1 (dsharded_step is
+        a single-round program)."""
+        if self._chunk > 1:
+            return False
+        return self._dense_matrix_bytes() > self._DENSE_MATRIX_HBM_LIMIT
+
     def _use_streamed(self) -> bool:
         """Pick the single-chip streaming round (parallel/streamed.py).
 
@@ -150,17 +197,30 @@ class Fedavg:
             return False
         if fr.dp_clip_threshold is not None:
             return False
-        d = sum(p.size for p in jax.tree.leaves(self.state.server.params))
-        return cfg.num_clients * d * 4 > 6 * (1 << 30)
+        return self._dense_matrix_bytes() > self._DENSE_MATRIX_HBM_LIMIT
 
     def _streamed_block(self) -> int:
         """Largest divisor of num_clients that is <= the configured
-        client_block (the streamed path needs an exact tiling)."""
+        client_block (the streamed path needs an exact tiling).  A client
+        count with no usable divisor (e.g. prime) silently degrading to
+        1-client dispatches would be a ~50x slowdown — warn loudly."""
         n, want = self.config.num_clients, max(1, self.config.client_block)
+        block = 1
         for b in range(min(want, n), 0, -1):
             if n % b == 0:
-                return b
-        return 1
+                block = b
+                break
+        if block < max(2, want // 4) and n > want:
+            import warnings
+
+            warnings.warn(
+                f"num_clients={n} has no divisor near client_block={want}; "
+                f"the streamed round degrades to {block}-client dispatches "
+                f"({n // block} per round). Pick a client count divisible "
+                "by the block (or a block dividing the count).",
+                stacklevel=2,
+            )
+        return block
 
     def _attach_root_data(self, fed_round: FedRound) -> FedRound:
         """Carve a clean server root dataset for FLTrust (Cao et al.): a few
