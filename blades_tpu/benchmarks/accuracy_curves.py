@@ -40,7 +40,10 @@ def run_cell(dataset, model, aggregator, num_malicious, adversary, rounds,
                   aggregator={"type": aggregator}, server_lr=1.0)
         .adversary(
             num_malicious_clients=num_malicious,
-            adversary_config={"type": adversary} if num_malicious else None,
+            adversary_config=(
+                (json.loads(adversary) if adversary.lstrip().startswith("{")
+                 else {"type": adversary}) if num_malicious else None
+            ),
         )
         .evaluation(evaluation_interval=max(rounds // 4, 1))
     )
@@ -69,7 +72,9 @@ def main(argv=None) -> int:
     p.add_argument("--rounds", type=int, default=200,
                    help="reduced from the canonical 2000 for turnaround")
     p.add_argument("--num-clients", type=int, default=60)
-    p.add_argument("--adversary", default="ALIE")
+    p.add_argument("--adversary", default="ALIE",
+                   help="attack name, or a JSON spec like "
+                   "'{\"type\": \"IPM\", \"scale\": 100.0}'")
     p.add_argument("--aggregators", nargs="+", default=DEFAULT_AGGREGATORS)
     p.add_argument("--malicious", nargs="+", type=int, default=DEFAULT_MALICIOUS)
     p.add_argument("--rounds-per-dispatch", type=int, default=10)
@@ -81,6 +86,23 @@ def main(argv=None) -> int:
     out = Path(args.out)
     out.mkdir(parents=True, exist_ok=True)
     rows = []
+
+    def write_table():
+        # Rewritten after EVERY cell: a killed multi-hour sweep still
+        # leaves a valid partial artifact.
+        synthetic = any(r["synthetic_data"] for r in rows)
+        table = {
+            "source": "SYNTHETIC fallback data (smoke shape, not a "
+                      "reproduction)" if synthetic else "real raw data",
+            "dataset": args.dataset, "model": model,
+            "adversary": args.adversary, "rounds": args.rounds,
+            "num_clients": args.num_clients,
+            "complete": len(rows) == len(args.aggregators) * len(args.malicious),
+            "rows": rows,
+        }
+        (out / "curves.json").write_text(json.dumps(table, indent=2))
+        return synthetic
+
     for agg in args.aggregators:
         for m in args.malicious:
             t0 = time.perf_counter()
@@ -90,16 +112,9 @@ def main(argv=None) -> int:
             row["wall_s"] = round(time.perf_counter() - t0, 1)
             rows.append(row)
             print(json.dumps(row), flush=True)
+            write_table()
 
-    synthetic = any(r["synthetic_data"] for r in rows)
-    table = {
-        "source": "SYNTHETIC fallback data (smoke shape, not a reproduction)"
-                  if synthetic else "real raw data",
-        "dataset": args.dataset, "model": model, "adversary": args.adversary,
-        "rounds": args.rounds, "num_clients": args.num_clients,
-        "rows": rows,
-    }
-    (out / "curves.json").write_text(json.dumps(table, indent=2))
+    synthetic = write_table()
 
     import matplotlib
 
