@@ -195,8 +195,6 @@ class Fedavg:
             fr.adversary, _COORDWISE_FORGERS
         ):
             return False
-        if fr.dp_clip_threshold is not None:
-            return False
         return self._dense_matrix_bytes() > self._DENSE_MATRIX_HBM_LIMIT
 
     def _streamed_block(self) -> int:

@@ -26,7 +26,14 @@ Median / Trimmedmean and update-forging adversaries that operate
 per-coordinate (ALIE, IPM, Noise, Adaptive), which is exactly the
 BASELINE.json headline workload (FedAvg + ALIE + Median).  Row-geometry
 aggregators (Krum, GeoMed, ...) need the d-sharded multi-chip path — they
-are rejected here with a pointer.
+are rejected here with a pointer.  Per-row DP (clip + Gaussian noise) IS
+supported: full-row norms are taken at train time (on the f32 updates,
+before storage rounding) and the chunked finish clips/noises with them —
+with f32 storage the clipping matches the dense path exactly; with bf16
+storage the clip is tightened by a half-ulp factor so the post-rounding
+row norm still respects the DP sensitivity bound.  Noise keys fold in
+the chunk index, so noise DRAWS differ from the dense path's single
+(n, d) draw (both are valid iid streams).
 
 1000 clients x ResNet-10 (d=4.9M) in bf16 = 9.8 GB: fits a single 16 GB
 v5e chip with ~1 GB chunk workspace.  ResNet-18 at n=1000 (22.3 GB bf16)
@@ -102,11 +109,7 @@ def streamed_step(
             f"{type(fr.adversary).__name__} forges with row geometry; use "
             "dsharded_step on a multi-chip mesh"
         )
-    if fr.dp_clip_threshold is not None:
-        raise NotImplementedError(
-            "per-row DP clipping needs full-row norms; use the dense or "
-            "d-sharded paths"
-        )
+    dp = fr.dp_clip_threshold is not None
     forges = _adv_forges(fr.adversary)
     hooks = fr._hooks()
 
@@ -130,6 +133,12 @@ def streamed_step(
         upd, opt2, loss = jax.vmap(one_client)(
             opt_b, bx, by, sl(train_keys), sl(malicious)
         )
+        # Full-row L2 norms, taken on the f32 updates BEFORE storage-dtype
+        # rounding — what chunked DP clipping needs and cannot recover
+        # from the matrix later.  Gated: the O(n*d) reduction is pure
+        # waste on non-DP rounds.
+        norms = (jnp.linalg.norm(upd, axis=1) if dp
+                 else jnp.zeros((upd.shape[0],), jnp.float32))
         updates_buf = lax.dynamic_update_slice(
             updates_buf, upd.astype(update_dtype), (row0, 0)
         )
@@ -137,15 +146,16 @@ def streamed_step(
             lambda full, blk: lax.dynamic_update_slice_in_dim(full, blk, row0, 0),
             client_opt, opt2,
         )
-        return updates_buf, client_opt, loss
+        return updates_buf, client_opt, loss, norms
 
     @jax.jit
-    def _finish(server_state, updates_buf, malicious, losses, k_adv):
+    def _finish(server_state, updates_buf, malicious, losses, row_norms,
+                k_adv, k_dp):
         n = updates_buf.shape[0]
         k = fr.num_clients
         if k is not None and k < n:  # drop ghost (padding) lanes
-            updates_buf, losses, malicious = (
-                updates_buf[:k], losses[:k], malicious[:k]
+            updates_buf, losses, malicious, row_norms = (
+                updates_buf[:k], losses[:k], malicious[:k], row_norms[:k]
             )
         n_eff, d = updates_buf.shape
         c = min(d_chunk, d)
@@ -168,6 +178,33 @@ def streamed_step(
                 # finite, so the aggregate guard semantics are unchanged.
                 chunk, chunk_healthy = sanitize_updates(chunk)
                 bad_acc = bad_acc | ~chunk_healthy
+            if dp:
+                # Same fixed point as FedRound.apply_dp: clip each row to
+                # the threshold using its FULL-row norm (precomputed at
+                # train time), then Gaussian noise.  Noise keys fold in
+                # the chunk index, so draws differ from the dense path's
+                # single (n, d) draw (both are valid iid streams).
+                # Lossy storage (bf16) can inflate a stored row's norm by
+                # up to a half-ulp factor past the f32 norm the scale was
+                # computed from — tighten the clip so the POST-rounding
+                # norm still respects the DP sensitivity bound.
+                thr = fr.dp_clip_threshold
+                if update_dtype != jnp.float32:
+                    thr = thr / (1.0 + 2.0 ** -8)
+                scale = jnp.where(
+                    jnp.isfinite(row_norms),
+                    jnp.minimum(1.0, thr / jnp.maximum(row_norms, 1e-12)),
+                    0.0,
+                )
+                chunk = chunk * scale[:, None]
+                if fr.dp_noise_factor:
+                    # Sigma stays calibrated to the NOMINAL threshold (the
+                    # sensitivity the (eps, delta) accounting uses); the
+                    # tightened thr above only affects the clip.
+                    sigma = fr.dp_noise_factor * fr.dp_clip_threshold
+                    chunk = chunk + sigma * jax.random.normal(
+                        jax.random.fold_in(k_dp, i), chunk.shape, chunk.dtype
+                    )
             if forges:
                 chunk = fr.adversary.on_updates_ready(
                     chunk, malicious, jax.random.fold_in(k_adv, i),
@@ -213,24 +250,26 @@ def streamed_step(
             raise ValueError(f"{n} clients not divisible by block {client_block}")
         if d_model is None:
             d_model = sum(p.size for p in jax.tree.leaves(state.server.params))
-        # Same RNG stream as FedRound.step (k_dp unused: DP is rejected).
-        k_sample, k_train, k_adv, _k_agg, _k_dp = jax.random.split(key, 5)
+        # Same RNG stream as FedRound.step.
+        k_sample, k_train, k_adv, _k_agg, k_dp = jax.random.split(key, 5)
         sample_keys = jax.random.split(k_sample, n)
         train_keys = jax.random.split(k_train, n)
         updates_buf = jnp.zeros((n, d_model), update_dtype)
         client_opt = state.client_opt
         if not donate:
             client_opt = jax.tree.map(jnp.copy, client_opt)
-        losses = []
+        losses, norms = [], []
         for b in range(n // client_block):
-            updates_buf, client_opt, loss = _train_block(
+            updates_buf, client_opt, loss, blk_norms = _train_block(
                 updates_buf, client_opt, state.server.params, data_x, data_y,
                 lengths, malicious, sample_keys, train_keys,
                 jnp.int32(b * client_block),
             )
             losses.append(loss)
+            norms.append(blk_norms)
         server, metrics = _finish(
-            state.server, updates_buf, malicious, jnp.concatenate(losses), k_adv
+            state.server, updates_buf, malicious, jnp.concatenate(losses),
+            jnp.concatenate(norms), k_adv, k_dp,
         )
         return RoundState(server=server, client_opt=client_opt), metrics
 
