@@ -6,10 +6,13 @@ Byzantine quarter, the server aggregates with coordinate-wise Median —
 one full FL round = local train + attack + robust aggregate + server
 step, all on device, via the single-chip streaming round
 (:mod:`blades_tpu.parallel.streamed`): bf16 update matrix, client-block
-``lax.map`` training, d-chunked forge+aggregate.  The Median runs as the
-single-pass pallas rank-select kernel (ops/pallas_select.py) — ~10x the
-XLA bitonic sort at n=1000, lifting the round from 0.33 to ~0.74
-rounds/s on one v5e chip.
+vmapped training, and the fully-fused finish — ALIE forge + exact
+Median in ONE pallas HBM pass over the bf16 matrix with a 16-step
+radix select in bf16 key space (ops/pallas_round.py).  Relative to the
+XLA bitonic-sort formulation that lifts the round from 0.33 to ~0.79
+rounds/s on one v5e chip (finish phase: ~900 -> ~86 ms); the remaining
+time is the vmapped per-client conv backward (XLA batch-grouped convs
+run at ~2x the cost of the same-FLOPs shared-weight backward).
 
 Model: ResNet-10 — the reference's canonical CIFAR-10 model
 (``global_model: resnet`` -> ``ResNet10()``, ref:

@@ -1,0 +1,292 @@
+"""Fused pallas finish for the streamed giant-federation round.
+
+The streamed round's finish phase (:mod:`blades_tpu.parallel.streamed`)
+is a chain of O(n*d) passes over the stored update matrix: cast the
+bf16 chunk to f32, sanitize, forge the malicious rows, aggregate, and
+accumulate row norms.  Chained as XLA ops those are ~10 full HBM round
+trips over a ~10 GB matrix — at n=1000 x d=4.9M the finish costs ~300 ms
+against a ~12 ms single-read floor.
+
+This kernel fuses the whole finish into ONE HBM pass: each grid step
+loads a full-height ``(n, block_d)`` column stripe into VMEM and, fully
+in-core, (a) casts to f32, (b) zeroes rows with non-finite values
+(stripe-local, the health-detection semantics of
+:func:`blades_tpu.core.health.sanitize_updates` at stripe granularity),
+(c) computes the benign column statistics and overwrites malicious rows
+with the forged row (ALIE ``mean + z*std`` or IPM ``-scale*mean`` —
+the deterministic coordinate-wise forges; ref:
+blades/adversaries/alie_adversary.py:27-45, ipm_adversary.py:15-23),
+(d) reduces the column to the aggregate (Mean over clients, exact
+radix-select Median, or Trimmedmean — same selection networks as
+:mod:`blades_tpu.ops.pallas_select`), and (e) accumulates per-row
+squared norms for the round metrics.
+
+Numerics: statistics run in f32 inside the kernel in the same formulas
+as :func:`blades_tpu.adversaries.base.benign_mean_std` (ddof=1), but
+reduction *order* differs from the XLA chunk path, so forged values can
+differ in the last ulp — the selection aggregators then pick among
+values containing those ulps.  Equivalence tests therefore use
+tolerances (tests/test_pallas_round.py); the chunked path remains the
+reference semantics and the fallback for every configuration the kernel
+does not cover (DP, keyed forges, row-geometry aggregators, n > 2048).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from blades_tpu.ops.pallas_select import (
+    _BLOCK_D,
+    _keys_of,
+    _kth_key,
+    _next_key_above,
+    _vals_of,
+    kernel_applicable,
+)
+
+
+def _keys16_of(x):
+    """Monotone uint32 keys living in the low 16 bits, for f32 values
+    that are bf16-representable (low 16 mantissa bits zero).
+
+    Such values carry 16 bits of entropy, so the radix select over them
+    needs 16 bit-search steps, not 32 — the fused kernel's dominant cost
+    halves.  Derived from :func:`_keys_of` by dropping the low half: for
+    bf16-representable values the low 16 key bits are constant per sign
+    (zeros for positives, ones for negatives), so the order lives
+    entirely in the top half.  Stays in uint32 throughout — Mosaic has
+    no 16-bit bitcasts/compares.
+    """
+    return _keys_of(x) >> 16
+
+
+def _vals16_of(k):
+    """Inverse of :func:`_keys16_of` (uint32 key -> f32 value).
+
+    Negative values' dropped low key bits were all-ones (``~b`` of a
+    zero low half), so reconstruct them before inverting.
+    """
+    k32 = k << 16
+    neg = (k >> 15) == 0  # top bit of the 16-bit key clear => negative
+    return _vals_of(jnp.where(neg, k32 | jnp.uint32(0xFFFF), k32))
+
+
+def _kth_key16(keys, k: int):
+    """16-step variant of :func:`_kth_key` for keys in [0, 0xFFFF]."""
+    c = keys.shape[1]
+    res = jnp.zeros((1, c), jnp.uint32)
+    for bit in range(15, -1, -1):
+        cand = res | jnp.uint32(1 << bit)
+        cnt = jnp.sum((keys < cand).astype(jnp.int32), axis=0, keepdims=True)
+        res = jnp.where(cnt <= k, cand, res)
+    return res
+
+
+def _next_key16_above(keys, v):
+    """Smallest key strictly greater than ``v`` per column."""
+    masked = jnp.where(keys > v, keys, jnp.uint32(0x10000)).astype(jnp.int32)
+    return jnp.min(masked, axis=0, keepdims=True).astype(jnp.uint32)
+
+def should_use(n: int, d: int) -> bool:
+    """Use the fused finish for this round?  The shared kernel gate
+    (backend / VMEM height bound / size floor / escape hatch, see
+    :func:`blades_tpu.ops.pallas_select.kernel_applicable`) plus a
+    sublane-alignment requirement: row padding inside ``fused_finish``
+    would copy the giant matrix."""
+    return kernel_applicable(n, d) and n % 8 == 0
+
+
+def _fused_kernel(x_ref, wb_ref, fm_ref, o_ref, sq_ref, bad_ref, *,
+                  n_true: int, forge: Optional[tuple], agg: tuple,
+                  sanitize: bool, keys16: bool):
+    i = pl.program_id(0)
+    x = x_ref[...].astype(jnp.float32)          # (n, c) stripe
+    wb = wb_ref[...]                            # (n, 1) benign weight
+    fm = fm_ref[...]                            # (n, 1) forge mask
+    real = jnp.minimum(wb + fm, 1.0)            # real (non-padding) rows
+
+    @pl.when(i == 0)
+    def _init():
+        sq_ref[...] = jnp.zeros_like(sq_ref)
+        bad_ref[...] = jnp.zeros_like(bad_ref)
+
+    if sanitize:
+        row_ok = jnp.isfinite(x).all(axis=1, keepdims=True)
+        row_bad = real * (1.0 - row_ok.astype(jnp.float32))
+        x = jnp.where(row_bad > 0, 0.0, x)
+        bad_ref[...] = jnp.maximum(bad_ref[...], row_bad)
+
+    # Zeroed view of the padding rows for every summation (0 * inf = nan
+    # otherwise); the rank computations re-mask them to +inf below.
+    xs = jnp.where(real > 0, x, 0.0)
+
+    if forge is not None:
+        kind = forge[0]
+        nb = jnp.maximum(jnp.sum(wb), 1.0)
+        mean = jnp.sum(xs * wb, axis=0, keepdims=True) / nb
+        if kind == "alie":
+            z = forge[1]
+            var = jnp.sum((xs - mean) ** 2 * wb, axis=0, keepdims=True)
+            std = jnp.sqrt(var / jnp.maximum(nb - 1.0, 1.0))
+            forged = mean + z * std
+        elif kind == "ipm":
+            forged = -forge[1] * mean
+        else:  # pragma: no cover - guarded by fused_finish
+            raise ValueError(f"unknown forge {kind!r}")
+        if keys16:
+            # bf16 storage: round the forged row to storage precision so
+            # every matrix value is bf16-representable — the semantics of
+            # an adversary writing into the same bf16 buffer, and what
+            # lets the rank search below run 16 steps instead of 32.
+            forged = forged.astype(jnp.bfloat16).astype(jnp.float32)
+        xs = jnp.where(fm > 0, forged, xs)
+
+    sq_ref[...] += jnp.sum(xs * xs, axis=1, keepdims=True)
+
+    if keys16:
+        # Every value in xs is bf16-representable here: benign rows come
+        # from bf16 storage, forged rows were rounded above, padding is
+        # +/-inf — so the 16-bit key space is exact.
+        kth, nxt, vals, keys_of = (
+            _kth_key16, _next_key16_above, _vals16_of, _keys16_of
+        )
+    else:
+        kth, nxt, vals, keys_of = _kth_key, _next_key_above, _vals_of, _keys_of
+
+    akind = agg[0]
+    if akind == "mean":
+        o_ref[...] = jnp.sum(xs, axis=0, keepdims=True) / n_true
+    elif akind == "median":
+        keys = keys_of(jnp.where(real > 0, xs, jnp.inf))
+        k1, k2 = (n_true - 1) // 2, n_true // 2
+        v1 = kth(keys, k1)
+        if k2 == k1:
+            o_ref[...] = vals(v1)
+        else:
+            cnt_le = jnp.sum((keys <= v1).astype(jnp.int32), axis=0,
+                             keepdims=True)
+            v2 = jnp.where(cnt_le >= k2 + 1, v1, nxt(keys, v1))
+            o_ref[...] = (vals(v1) + vals(v2)) * 0.5
+    elif akind == "trimmed":
+        k_cut = agg[1]
+        xm = jnp.where(real > 0, xs, jnp.inf)
+        keys = keys_of(xm)
+        vlo = kth(keys, k_cut)
+        vhi = kth(keys, n_true - 1 - k_cut)
+        flo, fhi = vals(vlo), vals(vhi)
+        between = (keys > vlo) & (keys < vhi)
+        sum_mid = jnp.sum(jnp.where(between, xm, 0.0), axis=0, keepdims=True)
+        cnt_lt_lo = jnp.sum((keys < vlo).astype(jnp.int32), axis=0,
+                            keepdims=True)
+        eq_lo = jnp.sum((keys == vlo).astype(jnp.int32), axis=0,
+                        keepdims=True)
+        cnt_lt_hi = jnp.sum((keys < vhi).astype(jnp.int32), axis=0,
+                            keepdims=True)
+        eq_hi = jnp.sum((keys == vhi).astype(jnp.int32), axis=0,
+                        keepdims=True)
+        lo_keep = jnp.clip(
+            jnp.minimum(cnt_lt_lo + eq_lo, n_true - k_cut)
+            - jnp.maximum(cnt_lt_lo, k_cut), 0, None)
+        hi_keep = jnp.clip(
+            jnp.minimum(cnt_lt_hi + eq_hi, n_true - k_cut)
+            - jnp.maximum(cnt_lt_hi, k_cut), 0, None)
+        kept = n_true - 2 * k_cut
+        total = sum_mid + lo_keep.astype(jnp.float32) * flo \
+            + hi_keep.astype(jnp.float32) * fhi
+        total = jnp.where(vlo == vhi, flo * kept, total)
+        o_ref[...] = total / kept
+    else:  # pragma: no cover - guarded by fused_finish
+        raise ValueError(f"unknown aggregator {akind!r}")
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("forge", "agg", "sanitize", "interpret"),
+)
+def fused_finish(
+    updates: jax.Array,
+    malicious: jax.Array,
+    *,
+    forge: Optional[tuple] = None,
+    agg: tuple = ("median",),
+    sanitize: bool = False,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Forge + aggregate the update matrix in one HBM pass.
+
+    Args:
+        updates: ``(n, d)`` stacked client updates, any float dtype
+            (bf16 storage reads at half bandwidth; compute is f32).
+        malicious: ``(n,)`` bool forge mask.
+        forge: ``None`` (no adversary), ``("alie", z_max)`` or
+            ``("ipm", scale)``.
+        agg: ``("mean",)``, ``("median",)`` or ``("trimmed", k_cut)``
+            with ``k_cut`` rows dropped per side.
+        sanitize: zero non-finite rows (stripe-local) and report them.
+
+    Returns:
+        ``(agg_vec, sq_norms, bad)`` — the ``(d,)`` f32 aggregate, the
+        ``(n,)`` per-row squared norms of the post-forge matrix, and the
+        ``(n,)`` bool row-unhealthy flags (all-False when ``sanitize``
+        is off).
+    """
+    n, d = updates.shape
+    if agg[0] == "trimmed" and n <= 2 * agg[1]:
+        raise ValueError(f"trimmed mean needs > {2 * agg[1]} rows, got {n}")
+    wb = jnp.where(malicious, 0.0, 1.0)[:, None].astype(jnp.float32)
+    fm = malicious[:, None].astype(jnp.float32)
+    # Row padding: +inf rows with wb = fm = 0 are invisible to the
+    # statistics and sort above every real value, so ranks over the true
+    # n are unchanged (same trick as pallas_select._pad_rows).
+    npad = -(-n // 8) * 8
+    if npad != n:
+        pad = jnp.full((npad - n, d), jnp.inf, updates.dtype)
+        updates = jnp.concatenate([updates, pad], axis=0)
+        z = jnp.zeros((npad - n, 1), jnp.float32)
+        wb = jnp.concatenate([wb, z], axis=0)
+        fm = jnp.concatenate([fm, z], axis=0)
+    # Column padding copies the matrix — callers at giant scale should
+    # allocate the update buffer pre-padded to a _BLOCK_D multiple
+    # (zero-filled padding columns aggregate to values that are sliced
+    # off below).
+    dpad = -(-d // _BLOCK_D) * _BLOCK_D
+    if dpad != d:
+        updates = jnp.pad(updates, ((0, 0), (0, dpad - d)))
+
+    kernel = functools.partial(
+        _fused_kernel, n_true=n, forge=forge, agg=agg, sanitize=sanitize,
+        keys16=updates.dtype == jnp.bfloat16,
+    )
+    agg_vec, sq, bad = pl.pallas_call(
+        kernel,
+        grid=(dpad // _BLOCK_D,),
+        in_specs=[
+            pl.BlockSpec((npad, _BLOCK_D), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((npad, 1), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((npad, 1), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, _BLOCK_D), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((npad, 1), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((npad, 1), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, dpad), jnp.float32),
+            jax.ShapeDtypeStruct((npad, 1), jnp.float32),
+            jax.ShapeDtypeStruct((npad, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(updates, wb, fm)
+    return agg_vec[0, :d], sq[:n, 0], bad[:n, 0] > 0

@@ -36,28 +36,29 @@ from jax.experimental.pallas import tpu as pltpu
 # comfortably up to n ≈ 4000.
 _BLOCK_D = 512
 
-# Escape hatch: BLADES_TPU_NO_PALLAS=1 forces the jnp.sort paths.
-_DISABLED = bool(int(os.environ.get("BLADES_TPU_NO_PALLAS", "0")))
-
-
-def should_use(x: jax.Array) -> bool:
-    """Use the pallas kernels for this matrix?  TPU backend, f32, tall
-    enough to select from, and big enough that the single-pass kernel
-    beats the fused-but-multi-pass XLA sort."""
-    if _DISABLED:
+def kernel_applicable(n: int, d: int) -> bool:
+    """Shared gate for the rank-select kernels here and the fused round
+    kernel (:mod:`blades_tpu.ops.pallas_round`): TPU backend, tall enough
+    to select from, short enough that full-height ``(n, _BLOCK_D)``
+    stripes fit VMEM (f32 values + uint32 keys ≈ n * 4 KiB against the
+    ~16 MiB budget), and big enough that a single-pass kernel beats the
+    fused-but-multi-pass XLA sort.  ``BLADES_TPU_NO_PALLAS=1`` (read per
+    call) is the escape hatch forcing the jnp paths."""
+    if bool(int(os.environ.get("BLADES_TPU_NO_PALLAS", "0"))):
         return False
     try:
         backend = jax.default_backend()
     except RuntimeError:  # no backend yet
         return False
+    return backend == "tpu" and 8 <= n <= 2048 and n * d >= (1 << 22)
+
+
+def should_use(x: jax.Array) -> bool:
+    """Use the rank-select kernels for this matrix?"""
     return (
-        backend == "tpu"
-        and x.dtype == jnp.float32
+        x.dtype == jnp.float32
         and x.ndim == 2
-        # Full-height column stripes must fit VMEM: (n, 512) f32 values +
-        # uint32 keys ≈ n * 4 KiB, so cap n well under the ~16 MiB budget.
-        and 8 <= x.shape[0] <= 2048
-        and x.shape[0] * x.shape[1] >= (1 << 22)
+        and kernel_applicable(x.shape[0], x.shape[1])
     )
 
 

@@ -1,0 +1,156 @@
+"""Fused streamed-finish kernel (ops/pallas_round.py) in interpret mode.
+
+The kernel is TPU-only in production (``should_use`` gates on the
+backend); these tests run it through the pallas interpreter on the CPU
+mesh and check it against the plain-jnp reference semantics the chunked
+finish implements: forge (ALIE/IPM) -> aggregate (Mean/Median/
+Trimmedmean), stripe-local sanitize, row norms.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from blades_tpu.adversaries.base import benign_mean_std
+from blades_tpu.ops.pallas_round import fused_finish
+
+STRIPE = 512  # pallas_select._BLOCK_D
+
+
+def _ref_forge(x, mal, forge, round_bf16=False):
+    mean, std = benign_mean_std(x, mal)
+    if forge is None:
+        return x
+    if forge[0] == "alie":
+        forged = mean + forge[1] * std
+    else:
+        forged = -forge[1] * mean
+    if round_bf16:
+        forged = forged.astype(jnp.bfloat16).astype(jnp.float32)
+    return jnp.where(mal[:, None], forged, x)
+
+
+def _ref_agg(x, agg):
+    n = x.shape[0]
+    if agg[0] == "mean":
+        return x.mean(axis=0)
+    s = jnp.sort(x, axis=0)
+    if agg[0] == "median":
+        return (s[(n - 1) // 2] + s[n // 2]) / 2
+    k = agg[1]
+    return s[k:n - k].mean(axis=0)
+
+
+@pytest.mark.parametrize("n,d", [(24, 1000), (17, 700), (64, 2048)])
+@pytest.mark.parametrize(
+    "forge,agg",
+    [
+        (("alie", 0.7), ("median",)),
+        (("ipm", 1.5), ("trimmed", 3)),
+        (None, ("mean",)),
+    ],
+)
+def test_fused_matches_reference(n, d, forge, agg):
+    rng = np.random.default_rng(seed=n + d)
+    x = jnp.asarray(rng.normal(size=(n, d)), jnp.float32)
+    mal = jnp.asarray(rng.random(n) < 0.25)
+    ref = _ref_forge(x, mal, forge)
+    agg_vec, sq, bad = fused_finish(x, mal, forge=forge, agg=agg,
+                                    interpret=True)
+    np.testing.assert_allclose(agg_vec, _ref_agg(ref, agg),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(sq, (ref ** 2).sum(axis=1),
+                               rtol=1e-4, atol=1e-4)
+    assert not bool(bad.any())
+
+
+@pytest.mark.parametrize("forge", [("alie", 0.7), ("ipm", 2.0), None])
+def test_fused_bf16_sixteen_step_radix(forge):
+    """bf16 storage: forged rows round to storage precision, selection is
+    exact in the 16-bit key space."""
+    n, d = 32, 1500
+    rng = np.random.default_rng(seed=5)
+    x16 = jnp.asarray(rng.normal(size=(n, d)), jnp.float32).astype(jnp.bfloat16)
+    mal = jnp.asarray(rng.random(n) < 0.25)
+    ref = _ref_forge(x16.astype(jnp.float32), mal, forge, round_bf16=True)
+    agg_vec, _, _ = fused_finish(x16, mal, forge=forge, agg=("median",),
+                                 interpret=True)
+    np.testing.assert_array_equal(
+        np.asarray(agg_vec), np.asarray(_ref_agg(ref, ("median",)))
+    )
+
+
+def test_fused_sanitize_stripe_local():
+    """A non-finite value zeroes its row within that 512-wide stripe only
+    (same chunk-local semantics as the streamed chunk path), and the row
+    is reported unhealthy."""
+    n, d = 16, STRIPE + 40
+    rng = np.random.default_rng(seed=7)
+    x = jnp.asarray(rng.normal(size=(n, d)), jnp.float32)
+    x = x.at[3, 2].set(jnp.inf)
+    mal = jnp.zeros((n,), bool)
+    agg_vec, sq, bad = fused_finish(x, mal, forge=None, agg=("mean",),
+                                    sanitize=True, interpret=True)
+    clean = x.at[3, :STRIPE].set(0.0)
+    np.testing.assert_allclose(agg_vec, clean.mean(axis=0), rtol=1e-5,
+                               atol=1e-6)
+    assert list(np.nonzero(np.asarray(bad))[0]) == [3]
+
+
+def test_fused_rejects_overtrimming():
+    x = jnp.zeros((8, 600), jnp.float32)
+    with pytest.raises(ValueError, match="trimmed"):
+        fused_finish(x, jnp.zeros((8,), bool), agg=("trimmed", 4),
+                     interpret=True)
+
+
+def test_streamed_step_fused_branch_matches_chunked(monkeypatch):
+    """Force the streamed round onto the fused finish (interpret mode)
+    and check the whole round matches the chunked finish."""
+    import functools
+
+    from blades_tpu import parallel
+    from blades_tpu.adversaries import get_adversary, make_malicious_mask
+    from blades_tpu.core import FedRound, Server, TaskSpec
+    from blades_tpu.ops import pallas_round
+
+    monkeypatch.setattr(pallas_round, "should_use", lambda n, d: True)
+    monkeypatch.setattr(
+        pallas_round, "fused_finish",
+        functools.partial(pallas_round.fused_finish.__wrapped__,
+                          interpret=True),
+    )
+
+    n, f = 12, 3
+    task = TaskSpec(model="mlp", input_shape=(8, 8, 1), num_classes=10,
+                    lr=0.1).build()
+    server = Server.from_config(aggregator="Median", lr=0.5)
+    adv = get_adversary("ALIE", num_clients=n, num_byzantine=f)
+    fr = FedRound(task=task, server=server, adversary=adv, batch_size=4,
+                  num_batches_per_round=1)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(n, 8, 8, 8, 1)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 10, size=(n, 8)), jnp.int32)
+    lengths = jnp.full((n,), 8, jnp.int32)
+    mal = make_malicious_mask(n, f)
+    key = jax.random.PRNGKey(3)
+
+    state0 = fr.init(jax.random.PRNGKey(0), n)
+    step_fused = parallel.streamed.streamed_step(
+        fr, client_block=4, update_dtype=jnp.float32, donate=False)
+    s1, m1 = step_fused(state0, x, y, lengths, mal, key)
+
+    monkeypatch.setattr(pallas_round, "should_use", lambda n, d: False)
+    state0 = fr.init(jax.random.PRNGKey(0), n)
+    step_chunked = parallel.streamed.streamed_step(
+        fr, client_block=4, update_dtype=jnp.float32, donate=False)
+    s2, m2 = step_chunked(state0, x, y, lengths, mal, key)
+
+    for k in ("train_loss", "agg_norm", "update_norm_mean"):
+        np.testing.assert_allclose(float(m1[k]), float(m2[k]), rtol=1e-5)
+    p1 = jax.tree.leaves(s1.server.params)
+    p2 = jax.tree.leaves(s2.server.params)
+    for a, b in zip(p1, p2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5,
+                                   atol=1e-6)
