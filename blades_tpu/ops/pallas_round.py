@@ -13,9 +13,10 @@ in-core, (a) casts to f32, (b) zeroes rows with non-finite values
 (stripe-local, the health-detection semantics of
 :func:`blades_tpu.core.health.sanitize_updates` at stripe granularity),
 (c) computes the benign column statistics and overwrites malicious rows
-with the forged row (ALIE ``mean + z*std`` or IPM ``-scale*mean`` —
-the deterministic coordinate-wise forges; ref:
-blades/adversaries/alie_adversary.py:27-45, ipm_adversary.py:15-23),
+with the forged row (ALIE ``mean + z*std``, IPM ``-scale*mean``, or the
+Fang/Adaptive directed deviation with pre-drawn uniforms — the
+coordinate-wise forges; ref: blades/adversaries/alie_adversary.py:27-45,
+ipm_adversary.py:15-23, adaptive_adversary.py:23-67),
 (d) reduces the column to the aggregate (Mean over clients, exact
 radix-select Median, or Trimmedmean — same selection networks as
 :mod:`blades_tpu.ops.pallas_select`), and (e) accumulates per-row
@@ -27,8 +28,12 @@ reduction *order* differs from the XLA chunk path, so forged values can
 differ in the last ulp — the selection aggregators then pick among
 values containing those ulps.  Equivalence tests therefore use
 tolerances (tests/test_pallas_round.py); the chunked path remains the
-reference semantics and the fallback for every configuration the kernel
-does not cover (DP, keyed forges, row-geometry aggregators, n > 2048).
+fallback for every configuration the kernel does not cover (DP, the
+keyed Noise forge, row-geometry aggregators, n > 2048).  For the
+Adaptive forge specifically, the caller pre-draws the ``(d,)`` uniforms
+with the round's adversary key, so the FUSED path reproduces the DENSE
+round's draw exactly — the chunked finish, which folds the key per
+d-chunk, draws differently (both are valid attack streams).
 """
 
 from __future__ import annotations
@@ -102,7 +107,7 @@ def should_use(n: int, d: int) -> bool:
     return kernel_applicable(n, d) and n % 8 == 0
 
 
-def _fused_kernel(x_ref, wb_ref, fm_ref, o_ref, sq_ref, bad_ref, *,
+def _fused_kernel(x_ref, wb_ref, fm_ref, r_ref, o_ref, sq_ref, bad_ref, *,
                   n_true: int, forge: Optional[tuple], agg: tuple,
                   sanitize: bool, keys16: bool):
     i = pl.program_id(0)
@@ -137,6 +142,28 @@ def _fused_kernel(x_ref, wb_ref, fm_ref, o_ref, sq_ref, bad_ref, *,
             forged = mean + z * std
         elif kind == "ipm":
             forged = -forge[1] * mean
+        elif kind == "adaptive":
+            # Fang directed deviation (the four sign-cases of
+            # AdaptiveAdversary.on_updates_ready); r_ref carries the
+            # pre-drawn per-coordinate uniforms.
+            b = forge[1]
+            r = r_ref[...]
+            mx = jnp.max(jnp.where(wb > 0, xs, -jnp.inf), axis=0,
+                         keepdims=True)
+            mn = jnp.min(jnp.where(wb > 0, xs, jnp.inf), axis=0,
+                         keepdims=True)
+            s = jnp.sign(mean)
+            neg_pos = r * ((b - 1.0) * mx) + mx
+            neg_neg = r * ((1.0 / b - 1.0) * mx) + mx
+            pos_pos = r * ((1.0 - 1.0 / b) * mn) + mn / b
+            pos_neg = r * ((1.0 - b) * mn) + mn * b
+            forged = jnp.where(
+                s == -1.0,
+                jnp.where(mx > 0, neg_pos, neg_neg),
+                jnp.where(s == 1.0,
+                          jnp.where(mn > 0, pos_pos, pos_neg),
+                          mean),
+            )
         else:  # pragma: no cover - guarded by fused_finish
             raise ValueError(f"unknown forge {kind!r}")
         if keys16:
@@ -212,6 +239,7 @@ def _fused_kernel(x_ref, wb_ref, fm_ref, o_ref, sq_ref, bad_ref, *,
 def fused_finish(
     updates: jax.Array,
     malicious: jax.Array,
+    forge_noise: Optional[jax.Array] = None,
     *,
     forge: Optional[tuple] = None,
     agg: tuple = ("median",),
@@ -224,8 +252,12 @@ def fused_finish(
         updates: ``(n, d)`` stacked client updates, any float dtype
             (bf16 storage reads at half bandwidth; compute is f32).
         malicious: ``(n,)`` bool forge mask.
-        forge: ``None`` (no adversary), ``("alie", z_max)`` or
-            ``("ipm", scale)``.
+        forge_noise: ``(d,)`` pre-drawn per-coordinate uniforms, required
+            by ``("adaptive", b)`` (drawing outside the kernel keeps it
+            RNG-free and lets the caller reproduce the dense round's
+            draw exactly).
+        forge: ``None`` (no adversary), ``("alie", z_max)``,
+            ``("ipm", scale)`` or ``("adaptive", b)``.
         agg: ``("mean",)``, ``("median",)`` or ``("trimmed", k_cut)``
             with ``k_cut`` rows dropped per side.
         sanitize: zero non-finite rows (stripe-local) and report them.
@@ -239,6 +271,16 @@ def fused_finish(
     n, d = updates.shape
     if agg[0] == "trimmed" and n <= 2 * agg[1]:
         raise ValueError(f"trimmed mean needs > {2 * agg[1]} rows, got {n}")
+    if forge is not None and forge[0] == "adaptive":
+        if forge_noise is None:
+            raise ValueError("('adaptive', b) forging needs forge_noise")
+        if forge_noise.shape != (d,):
+            raise ValueError(
+                f"forge_noise must be ({d},), got {forge_noise.shape}"
+            )
+        rbuf = forge_noise.astype(jnp.float32)[None, :]
+    else:
+        rbuf = jnp.zeros((1, d), jnp.float32)
     wb = jnp.where(malicious, 0.0, 1.0)[:, None].astype(jnp.float32)
     fm = malicious[:, None].astype(jnp.float32)
     # Row padding: +inf rows with wb = fm = 0 are invisible to the
@@ -258,6 +300,8 @@ def fused_finish(
     dpad = -(-d // _BLOCK_D) * _BLOCK_D
     if dpad != d:
         updates = jnp.pad(updates, ((0, 0), (0, dpad - d)))
+    if rbuf.shape[1] != dpad:
+        rbuf = jnp.pad(rbuf, ((0, 0), (0, dpad - rbuf.shape[1])))
 
     kernel = functools.partial(
         _fused_kernel, n_true=n, forge=forge, agg=agg, sanitize=sanitize,
@@ -272,6 +316,8 @@ def fused_finish(
             pl.BlockSpec((npad, 1), lambda i: (0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((npad, 1), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _BLOCK_D), lambda i: (0, i),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=[
@@ -288,5 +334,5 @@ def fused_finish(
             jax.ShapeDtypeStruct((npad, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(updates, wb, fm)
+    )(updates, wb, fm, rbuf)
     return agg_vec[0, :d], sq[:n, 0], bad[:n, 0] > 0

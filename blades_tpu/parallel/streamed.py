@@ -35,8 +35,8 @@ row norm still respects the DP sensitivity bound.  Noise keys fold in
 the chunk index, so noise DRAWS differ from the dense path's single
 (n, d) draw (both are valid iid streams).
 
-On a TPU backend, rounds whose forge is deterministic coordinate-wise
-(ALIE/IPM), whose aggregator is Mean/Median/Trimmedmean, and that run
+On a TPU backend, rounds whose forge is coordinate-wise
+(ALIE/IPM/Adaptive), whose aggregator is Mean/Median/Trimmedmean, and that run
 without DP skip the chunked ``lax.scan`` finish entirely: the whole
 finish (sanitize + forge + aggregate + row norms) runs as ONE fused
 pallas kernel in a single HBM pass over the stored matrix
@@ -98,6 +98,8 @@ def _fused_spec(fr: FedRound):
         fspec = ("alie", float(adv.z_max))
     elif isinstance(adv, IPMAdversary):
         fspec = ("ipm", float(adv.scale))
+    elif isinstance(adv, AdaptiveAdversary):
+        fspec = ("adaptive", float(adv.b))
     else:
         return None
     return fspec, aspec
@@ -124,7 +126,11 @@ def streamed_step(
     backend eligible rounds take the fused pallas finish instead, whose
     in-kernel reduction order can differ in the last ulp — set
     ``BLADES_TPU_NO_PALLAS=1`` to force the chunked path when bitwise
-    reproduction against the dense round matters.
+    reproduction against the dense round matters.  Exception: the
+    Adaptive (Fang) forge draws per-coordinate uniforms, and there the
+    FUSED path reproduces the dense round's single ``(d,)`` draw exactly
+    while the chunked path folds the key per d-chunk — different (but
+    equally valid) forged rows; see :mod:`blades_tpu.ops.pallas_round`.
 
     Args:
         client_block: clients trained per dispatch (bounds activation
@@ -293,21 +299,31 @@ def streamed_step(
     spec = _fused_spec(fr)
 
     @jax.jit
-    def _finish_fused(server_state, updates_buf, malicious, losses):
+    def _finish_fused(server_state, updates_buf, malicious, losses, k_adv):
         from blades_tpu.ops.pallas_round import fused_finish
 
         # No ghost-lane slice here: the fused path is only selected when
         # num_clients == n (a row slice feeding pallas_call would
         # materialize a second near-full copy of the giant matrix).
+        # Model width from the server params themselves, so this program
+        # is self-contained (buffer columns are stripe-padded past d).
+        d = sum(p.size for p in jax.tree.leaves(server_state.params))
         forge, aspec = spec
+        noise = None
+        if forge is not None and forge[0] == "adaptive":
+            # The dense round's exact per-coordinate draw
+            # (AdaptiveAdversary.on_updates_ready with shard=None),
+            # zero-extended over the buffer's stripe-padding columns
+            # (whose all-zero stats forge to 0 regardless of r).
+            noise = jax.random.uniform(k_adv, (d,), jnp.float32)
+            d_alloc = updates_buf.shape[1]
+            if d_alloc != d:
+                noise = jnp.pad(noise, (0, d_alloc - d))
         agg_vec, sq_norms, bad_rows = fused_finish(
-            updates_buf, malicious, forge=forge, agg=aspec,
+            updates_buf, malicious, noise, forge=forge, agg=aspec,
             sanitize=fr.health_check,
         )
-        # Drop stripe-alignment padding columns (model width from the
-        # server params themselves, so this program is self-contained).
-        d = sum(p.size for p in jax.tree.leaves(server_state.params))
-        agg_vec = agg_vec[:d]
+        agg_vec = agg_vec[:d]  # drop stripe-alignment padding columns
         return _serve_aggregate(server_state, agg_vec, malicious, losses,
                                 sq_norms, bad_rows)
 
@@ -357,7 +373,8 @@ def streamed_step(
             norms.append(blk_norms)
         if use_fused:
             server, metrics = _finish_fused(
-                state.server, updates_buf, malicious, jnp.concatenate(losses)
+                state.server, updates_buf, malicious, jnp.concatenate(losses),
+                k_adv,
             )
         else:
             server, metrics = _finish(

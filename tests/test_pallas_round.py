@@ -81,6 +81,33 @@ def test_fused_bf16_sixteen_step_radix(forge):
     )
 
 
+def test_fused_adaptive_matches_adversary_hook():
+    """('adaptive', b) with pre-drawn uniforms reproduces the dense
+    AdaptiveAdversary.on_updates_ready forge exactly (same key)."""
+    from blades_tpu.adversaries import get_adversary
+
+    n, d = 24, 900
+    rng = np.random.default_rng(seed=11)
+    x = jnp.asarray(rng.normal(size=(n, d)), jnp.float32)
+    mal = jnp.asarray(rng.random(n) < 0.25)
+    key = jax.random.PRNGKey(42)
+    adv = get_adversary({"type": "Adaptive", "b": 2.0},
+                        num_clients=n, num_byzantine=int(mal.sum()))
+    ref = adv.on_updates_ready(x, mal, key)
+    noise = jax.random.uniform(key, (d,), jnp.float32)
+    agg_vec, _, _ = fused_finish(x, mal, noise, forge=("adaptive", 2.0),
+                                 agg=("median",), interpret=True)
+    np.testing.assert_allclose(agg_vec, _ref_agg(ref, ("median",)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_fused_adaptive_requires_noise():
+    x = jnp.zeros((8, 600), jnp.float32)
+    with pytest.raises(ValueError, match="forge_noise"):
+        fused_finish(x, jnp.zeros((8,), bool), forge=("adaptive", 2.0),
+                     agg=("mean",), interpret=True)
+
+
 def test_fused_sanitize_stripe_local():
     """A non-finite value zeroes its row within that 512-wide stripe only
     (same chunk-local semantics as the streamed chunk path), and the row
