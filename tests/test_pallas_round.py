@@ -101,6 +101,32 @@ def test_fused_adaptive_matches_adversary_hook():
                                rtol=1e-5, atol=1e-5)
 
 
+def test_fused_adaptive_bf16_matches_rounded_reference():
+    """The production combination: adaptive forge + bf16 storage — the
+    forged row rounds to bf16 and the 16-step radix selects among the
+    rounded values exactly."""
+    from blades_tpu.adversaries import get_adversary
+
+    n, d = 24, 900
+    rng = np.random.default_rng(seed=13)
+    x16 = jnp.asarray(rng.normal(size=(n, d)), jnp.float32).astype(jnp.bfloat16)
+    mal = jnp.asarray(rng.random(n) < 0.25)
+    key = jax.random.PRNGKey(21)
+    adv = get_adversary({"type": "Adaptive", "b": 2.0},
+                        num_clients=n, num_byzantine=int(mal.sum()))
+    xf = x16.astype(jnp.float32)
+    ref = adv.on_updates_ready(xf, mal, key)
+    # forged rows round to storage precision in the kernel
+    ref = jnp.where(mal[:, None], ref.astype(jnp.bfloat16).astype(jnp.float32),
+                    ref)
+    noise = jax.random.uniform(key, (d,), jnp.float32)
+    agg_vec, _, _ = fused_finish(x16, mal, noise, forge=("adaptive", 2.0),
+                                 agg=("median",), interpret=True)
+    np.testing.assert_array_equal(
+        np.asarray(agg_vec), np.asarray(_ref_agg(ref, ("median",)))
+    )
+
+
 def test_fused_adaptive_requires_noise():
     x = jnp.zeros((8, 600), jnp.float32)
     with pytest.raises(ValueError, match="forge_noise"):
