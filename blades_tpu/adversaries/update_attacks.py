@@ -112,7 +112,7 @@ class NoiseAdversary(Adversary):
                          global_params=None, shard=None):
         del aggregator, global_params
         if shard is not None:
-            key = jax.random.fold_in(key, lax.axis_index(shard.axis))
+            key = shard.fold(key)
         noise = self.mean + self.std * jax.random.normal(key, updates.shape,
                                                          updates.dtype)
         if shard is not None:

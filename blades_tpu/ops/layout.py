@@ -63,6 +63,58 @@ class ShardInfo:
     def psum(self, x: jax.Array) -> jax.Array:
         return lax.psum(x, self.axis)
 
+    def fold(self, key: jax.Array) -> jax.Array:
+        """Fold a layout-unique index into ``key`` so per-window draws are
+        independent (the device index here; the chunk index on
+        :class:`ChunkInfo`)."""
+        return jax.random.fold_in(key, lax.axis_index(self.axis))
+
+
+@dataclasses.dataclass(frozen=True)
+class ChunkInfo:
+    """Column-window layout for the single-chip streamed round.
+
+    The streamed finish walks the dense ``(n, d)`` matrix in column
+    chunks ``[start, start + width)`` (:mod:`blades_tpu.parallel.
+    streamed`); coordinate-wise forgers receive a ``ChunkInfo`` so
+    coordinate-position logic (ALIE's SignGuard-evasion negate-first-
+    half, Adaptive's global uniform draw via :func:`slice_to_shard`)
+    uses GLOBAL coordinates — the same landmine ShardInfo defuses for
+    width shards.  Unlike a width shard, every chunk holds FULL rows of
+    its columns, and there is no cross-window reduction: row geometry is
+    not available, so ``psum`` refuses (row-geometry forgers are
+    rejected up front by the streamed path).
+
+    ``start`` and ``index`` are traced scalars (the scan carries them).
+    """
+
+    global_d: int
+    width: int
+    start: jax.Array
+    index: jax.Array
+
+    @property
+    def d_pad(self) -> int:
+        return self.global_d
+
+    def offset(self) -> jax.Array:
+        return self.start
+
+    def coords(self) -> jax.Array:
+        return self.start + jnp.arange(self.width)
+
+    def valid(self) -> jax.Array:
+        return self.coords() < self.global_d
+
+    def psum(self, x: jax.Array) -> jax.Array:
+        raise TypeError(
+            "a column chunk has no cross-window reduction — row geometry "
+            "needs the d-sharded mesh path (parallel/dsharded.py)"
+        )
+
+    def fold(self, key: jax.Array) -> jax.Array:
+        return jax.random.fold_in(key, self.index)
+
 
 def psum_if(x: jax.Array, shard: Optional[ShardInfo]) -> jax.Array:
     """``psum`` a shard-partial reduction, or pass through when dense."""

@@ -21,13 +21,17 @@ answers:
   1000-client scale; buffer donation across dispatches is what makes the
   matrix + workspace fit in 16 GB.
 
-This covers the coordinate-wise slice of the suite — aggregators Mean /
-Median / Trimmedmean and update-forging adversaries that operate
-per-coordinate (ALIE, IPM, Noise, Adaptive), which is exactly the
-BASELINE.json headline workload (FedAvg + ALIE + Median).  Row-geometry
-aggregators (Krum, GeoMed, ...) need the d-sharded multi-chip path — they
-are rejected here with a pointer.  Per-row DP (clip + Gaussian noise) IS
-supported: full-row norms are taken at train time (on the f32 updates,
+The whole aggregator suite runs here.  The coordinate-wise slice —
+Mean / Median / Trimmedmean, exactly the BASELINE.json headline workload
+(FedAvg + ALIE + Median) — aggregates inside the chunked (or fused
+pallas) finish.  The row-geometry aggregators (GeoMed, Multikrum, DnC,
+Centeredclipping, Signguard, Clippedclustering, FLTrust) run as
+full-matrix passes over the stored buffer
+(:mod:`blades_tpu.parallel.streamed_geometry`) after a materialization
+scan writes sanitize/DP/forge back into it.  Update-forging adversaries
+must be coordinate-wise (ALIE, IPM, Noise, Adaptive) — row-geometry
+FORGERS still need the d-sharded multi-chip path.  Per-row DP (clip +
+Gaussian noise) IS supported: full-row norms are taken at train time (on the f32 updates,
 before storage rounding) and the chunked finish clips/noises with them —
 with f32 storage the clipping matches the dense path exactly; with bf16
 storage the clip is tightened by a half-ulp factor so the post-rounding
@@ -146,10 +150,13 @@ def streamed_step(
             caller's state alive at the cost of one opt-state copy per
             round.
     """
+    from blades_tpu.parallel.streamed_geometry import STREAMED_ROW_AGGREGATORS
+
     agg = fr.server.aggregator
-    if not isinstance(agg, _COORDWISE_AGGREGATORS):
+    row_geom = isinstance(agg, STREAMED_ROW_AGGREGATORS)
+    if not row_geom and not isinstance(agg, _COORDWISE_AGGREGATORS):
         raise NotImplementedError(
-            f"{type(agg).__name__} needs row geometry over the full width; "
+            f"{type(agg).__name__} has no streamed formulation; "
             "use dsharded_step on a multi-chip mesh for giant federations"
         )
     if _adv_forges(fr.adversary) and not isinstance(fr.adversary, _COORDWISE_FORGERS):
@@ -160,6 +167,27 @@ def streamed_step(
     dp = fr.dp_clip_threshold is not None
     forges = _adv_forges(fr.adversary)
     hooks = fr._hooks()
+
+    def _dp_chunk(chunk, row_norms, k_dp, i):
+        """Per-chunk DP clip + noise against the train-time full-row
+        norms — the streamed fixed point of FedRound.apply_dp (see the
+        module docstring for the bf16 clip tightening and the per-chunk
+        noise keys)."""
+        thr = fr.dp_clip_threshold
+        if update_dtype != jnp.float32:
+            thr = thr / (1.0 + 2.0 ** -8)
+        scale = jnp.where(
+            jnp.isfinite(row_norms),
+            jnp.minimum(1.0, thr / jnp.maximum(row_norms, 1e-12)),
+            0.0,
+        )
+        chunk = chunk * scale[:, None]
+        if fr.dp_noise_factor:
+            sigma = fr.dp_noise_factor * fr.dp_clip_threshold
+            chunk = chunk + sigma * jax.random.normal(
+                jax.random.fold_in(k_dp, i), chunk.shape, chunk.dtype
+            )
+        return chunk
 
     @partial(jax.jit, donate_argnums=(0, 1))
     def _train_block(updates_buf, client_opt, params, x, y, lengths,
@@ -232,27 +260,7 @@ def streamed_step(
                 # train time), then Gaussian noise.  Noise keys fold in
                 # the chunk index, so draws differ from the dense path's
                 # single (n, d) draw (both are valid iid streams).
-                # Lossy storage (bf16) can inflate a stored row's norm by
-                # up to a half-ulp factor past the f32 norm the scale was
-                # computed from — tighten the clip so the POST-rounding
-                # norm still respects the DP sensitivity bound.
-                thr = fr.dp_clip_threshold
-                if update_dtype != jnp.float32:
-                    thr = thr / (1.0 + 2.0 ** -8)
-                scale = jnp.where(
-                    jnp.isfinite(row_norms),
-                    jnp.minimum(1.0, thr / jnp.maximum(row_norms, 1e-12)),
-                    0.0,
-                )
-                chunk = chunk * scale[:, None]
-                if fr.dp_noise_factor:
-                    # Sigma stays calibrated to the NOMINAL threshold (the
-                    # sensitivity the (eps, delta) accounting uses); the
-                    # tightened thr above only affects the clip.
-                    sigma = fr.dp_noise_factor * fr.dp_clip_threshold
-                    chunk = chunk + sigma * jax.random.normal(
-                        jax.random.fold_in(k_dp, i), chunk.shape, chunk.dtype
-                    )
+                chunk = _dp_chunk(chunk, row_norms, k_dp, i)
             if forges:
                 chunk = fr.adversary.on_updates_ready(
                     chunk, malicious, jax.random.fold_in(k_adv, i),
@@ -275,10 +283,10 @@ def streamed_step(
                                 sq_norms, bad_rows)
 
     def _serve_aggregate(server_state, agg_vec, malicious, losses, sq_norms,
-                         bad_rows):
+                         bad_rows, agg_state=None):
         """Shared finish tail: server step + round metrics + health guard
-        (identical for the chunked and fused finishes)."""
-        server = fr.server.apply_aggregate(server_state, agg_vec)
+        (identical for the chunked, fused, and row-geometry finishes)."""
+        server = fr.server.apply_aggregate(server_state, agg_vec, agg_state)
         benign = (~malicious).astype(jnp.float32)
         train_loss = (losses * benign).sum() / jnp.maximum(benign.sum(), 1.0)
         metrics = {
@@ -327,6 +335,79 @@ def streamed_step(
         return _serve_aggregate(server_state, agg_vec, malicious, losses,
                                 sq_norms, bad_rows)
 
+    # Whether the row-geometry materialization rewrites the buffer at all
+    # (when not, the buffer is read-only and one stats pass suffices).
+    _rowgeom_rewrites = forges or dp or fr.health_check
+
+    @partial(jax.jit, donate_argnums=(0, 1, 2))
+    def _rowgeom_mat_chunk(updates_buf, sq_acc, bad_acc, malicious,
+                           row_norms, k_adv, k_dp, i, start):
+        """One chunk of the row-geometry materialization: sanitize/DP/
+        forge the chunk and write it back into the DONATED buffer.
+
+        A host loop of donated dispatches, not a ``lax.scan`` — a giant
+        scan carry double-buffers the matrix in HLO and OOMs at the
+        1000-client scale (the same reason training runs as per-block
+        dispatches).  Forgers receive a
+        :class:`~blades_tpu.ops.layout.ChunkInfo` and the UNFOLDED round
+        key, so coordinate-position logic and global draws match the
+        dense round exactly (NoiseAdversary folds the chunk index itself
+        via ``shard.fold``).
+        """
+        from blades_tpu.ops.layout import ChunkInfo
+
+        n, d = updates_buf.shape
+        c = min(d_chunk, d)
+        raw = lax.dynamic_slice(updates_buf, (0, start), (n, c))
+        chunk = raw.astype(jnp.float32)
+        if fr.health_check:
+            from blades_tpu.core.health import sanitize_updates
+
+            chunk, chunk_healthy = sanitize_updates(chunk)
+            bad_acc = bad_acc | ~chunk_healthy
+        if dp:
+            chunk = _dp_chunk(chunk, row_norms, k_dp, i)
+        if forges:
+            chunk = fr.adversary.on_updates_ready(
+                chunk, malicious, k_adv, aggregator=agg, global_params=None,
+                shard=ChunkInfo(global_d=d, width=c, start=start, index=i),
+            )
+        new = (start + jnp.arange(c)) >= i * c
+        sq_acc = sq_acc + jnp.where(new[None, :], chunk**2, 0.0).sum(axis=1)
+        # Write back ONLY this chunk's not-yet-covered columns: the tail
+        # chunk overlaps its predecessor, and DP clip/noise (and Noise
+        # forging) are not idempotent — reprocessing the overlap would
+        # double-clip and double-noise it.
+        updates_buf = lax.dynamic_update_slice(
+            updates_buf,
+            jnp.where(new[None, :], chunk.astype(update_dtype), raw),
+            (0, start),
+        )
+        return updates_buf, sq_acc, bad_acc
+
+    @jax.jit
+    def _rowgeom_sq(updates_buf):
+        from blades_tpu.parallel.streamed_geometry import row_sq_norms
+
+        return row_sq_norms(updates_buf, d_chunk)
+
+    @jax.jit
+    def _rowgeom_aggregate(server_state, updates_buf, malicious, losses,
+                           sq, bad_rows, k_agg):
+        """Aggregator passes over the (read-only, post-materialization)
+        buffer + the shared serve tail."""
+        from blades_tpu.parallel.streamed_geometry import aggregate_streamed
+
+        trusted = fr.compute_trusted_update(
+            server_state.params, jax.random.fold_in(k_agg, 1)
+        )
+        agg_vec, agg_state = aggregate_streamed(
+            agg, updates_buf, sq, server_state.agg_state, key=k_agg,
+            trusted=trusted, d_chunk=d_chunk,
+        )
+        return _serve_aggregate(server_state, agg_vec, malicious, losses,
+                                sq, bad_rows, agg_state=agg_state)
+
     d_model = None  # resolved from params on first call
 
     def step(state: RoundState, data_x, data_y, lengths, malicious, key):
@@ -334,6 +415,15 @@ def streamed_step(
         n = data_x.shape[0]
         if n % client_block:
             raise ValueError(f"{n} clients not divisible by block {client_block}")
+        if row_geom and fr.num_clients is not None and fr.num_clients != n:
+            # Checked BEFORE training: the round below donates the
+            # caller's opt state and burns a full training pass.
+            raise ValueError(
+                f"the streamed row-geometry finish needs num_clients "
+                f"({fr.num_clients}) == data rows ({n}): ghost lanes "
+                "would enter the row geometry — pick a client_block "
+                "that divides num_clients"
+            )
         if d_model is None:
             d_model = sum(p.size for p in jax.tree.leaves(state.server.params))
         from blades_tpu.ops.pallas_round import should_use
@@ -346,7 +436,7 @@ def streamed_step(
         use_fused = (spec is not None and no_ghosts
                      and should_use(n, d_model))
         # Same RNG stream as FedRound.step.
-        k_sample, k_train, k_adv, _k_agg, k_dp = jax.random.split(key, 5)
+        k_sample, k_train, k_adv, k_agg, k_dp = jax.random.split(key, 5)
         sample_keys = jax.random.split(k_sample, n)
         train_keys = jax.random.split(k_train, n)
         # The fused pallas finish wants stripe-aligned columns; padding
@@ -371,7 +461,26 @@ def streamed_step(
             )
             losses.append(loss)
             norms.append(blk_norms)
-        if use_fused:
+        if row_geom:
+            if _rowgeom_rewrites:
+                sq = jnp.zeros((n,), jnp.float32)
+                bad = jnp.zeros((n,), bool)
+                cat_norms = jnp.concatenate(norms)
+                c = min(d_chunk, d_model)
+                for i in range(-(-d_model // c)):
+                    updates_buf, sq, bad = _rowgeom_mat_chunk(
+                        updates_buf, sq, bad, malicious, cat_norms,
+                        k_adv, k_dp, jnp.int32(i),
+                        jnp.int32(min(i * c, d_model - c)),
+                    )
+            else:
+                sq = _rowgeom_sq(updates_buf)
+                bad = jnp.zeros((n,), bool)
+            server, metrics = _rowgeom_aggregate(
+                state.server, updates_buf, malicious, jnp.concatenate(losses),
+                sq, bad, k_agg,
+            )
+        elif use_fused:
             server, metrics = _finish_fused(
                 state.server, updates_buf, malicious, jnp.concatenate(losses),
                 k_adv,
