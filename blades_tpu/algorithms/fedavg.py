@@ -187,9 +187,15 @@ class Fedavg:
             _COORDWISE_FORGERS,
             _adv_forges,
         )
+        from blades_tpu.parallel.streamed_geometry import (
+            STREAMED_ROW_AGGREGATORS,
+        )
 
         fr = self.fed_round
-        if not isinstance(fr.server.aggregator, _COORDWISE_AGGREGATORS):
+        if not isinstance(
+            fr.server.aggregator,
+            _COORDWISE_AGGREGATORS + STREAMED_ROW_AGGREGATORS,
+        ):
             return False
         if _adv_forges(fr.adversary) and not isinstance(
             fr.adversary, _COORDWISE_FORGERS

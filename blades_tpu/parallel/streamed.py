@@ -154,6 +154,13 @@ def streamed_step(
 
     agg = fr.server.aggregator
     row_geom = isinstance(agg, STREAMED_ROW_AGGREGATORS)
+    if (getattr(agg, "expects_trusted_row", False)
+            and fr.trusted_data is None):
+        raise ValueError(
+            f"{type(agg).__name__} requires FedRound.trusted_data (the "
+            "server's root data) — without it the defense has no root of "
+            "trust"
+        )
     if not row_geom and not isinstance(agg, _COORDWISE_AGGREGATORS):
         raise NotImplementedError(
             f"{type(agg).__name__} has no streamed formulation; "
@@ -356,6 +363,8 @@ def streamed_step(
         """
         from blades_tpu.ops.layout import ChunkInfo
 
+        from blades_tpu.parallel.streamed_geometry import new_cols
+
         n, d = updates_buf.shape
         c = min(d_chunk, d)
         raw = lax.dynamic_slice(updates_buf, (0, start), (n, c))
@@ -372,7 +381,7 @@ def streamed_step(
                 chunk, malicious, k_adv, aggregator=agg, global_params=None,
                 shard=ChunkInfo(global_d=d, width=c, start=start, index=i),
             )
-        new = (start + jnp.arange(c)) >= i * c
+        new = new_cols(start, i, c)
         sq_acc = sq_acc + jnp.where(new[None, :], chunk**2, 0.0).sum(axis=1)
         # Write back ONLY this chunk's not-yet-covered columns: the tail
         # chunk overlaps its predecessor, and DP clip/noise (and Noise
@@ -415,15 +424,19 @@ def streamed_step(
         n = data_x.shape[0]
         if n % client_block:
             raise ValueError(f"{n} clients not divisible by block {client_block}")
-        if row_geom and fr.num_clients is not None and fr.num_clients != n:
+        if row_geom:
             # Checked BEFORE training: the round below donates the
             # caller's opt state and burns a full training pass.
-            raise ValueError(
-                f"the streamed row-geometry finish needs num_clients "
-                f"({fr.num_clients}) == data rows ({n}): ghost lanes "
-                "would enter the row geometry — pick a client_block "
-                "that divides num_clients"
-            )
+            if fr.num_clients is not None and fr.num_clients != n:
+                raise ValueError(
+                    f"the streamed row-geometry finish needs num_clients "
+                    f"({fr.num_clients}) == data rows ({n}): ghost lanes "
+                    "would enter the row geometry — pick a client_block "
+                    "that divides num_clients"
+                )
+            from blades_tpu.parallel.streamed_geometry import check_applicable
+
+            check_applicable(agg, n)
         if d_model is None:
             d_model = sum(p.size for p in jax.tree.leaves(state.server.params))
         from blades_tpu.ops.pallas_round import should_use
@@ -463,11 +476,13 @@ def streamed_step(
             norms.append(blk_norms)
         if row_geom:
             if _rowgeom_rewrites:
+                from blades_tpu.parallel.streamed_geometry import chunk_grid
+
                 sq = jnp.zeros((n,), jnp.float32)
                 bad = jnp.zeros((n,), bool)
                 cat_norms = jnp.concatenate(norms)
-                c = min(d_chunk, d_model)
-                for i in range(-(-d_model // c)):
+                c, k_chunks, _ = chunk_grid(d_model, d_chunk)
+                for i in range(k_chunks):
                     updates_buf, sq, bad = _rowgeom_mat_chunk(
                         updates_buf, sq, bad, malicious, cat_norms,
                         k_adv, k_dp, jnp.int32(i),

@@ -58,11 +58,43 @@ STREAMED_ROW_AGGREGATORS = (
 )
 
 
-def _chunk_grid(d: int, c: int):
+def chunk_grid(d: int, c: int):
+    """The streamed chunking scheme, shared by every consumer: fixed
+    width ``c`` (clamped to ``d``), ``k`` chunks, starts
+    ``min(i*c, d - c)`` — the tail chunk overlaps its predecessor."""
     c = min(c, d)
     k = -(-d // c)
     starts = jnp.minimum(jnp.arange(k) * c, d - c)
     return c, k, starts
+
+
+def new_cols(start, i, c: int):
+    """Mask of this chunk's columns NOT covered by earlier chunks (the
+    overlap-tail invariant every accumulator and write-back relies on)."""
+    return (start + jnp.arange(c)) >= i * c
+
+
+def check_applicable(agg, n: int) -> None:
+    """Raise the aggregator's n-dependent config errors.
+
+    Called by the streamed round BEFORE training (so a bad config cannot
+    burn a full training pass and the caller's donated state) and again
+    by the implementations below.
+    """
+    if isinstance(agg, Multikrum):
+        if 2 * agg.num_byzantine + 2 > n:
+            raise ValueError(
+                f"Too many Byzantine workers: 2*{agg.num_byzantine}+2 > {n}"
+            )
+        if not (1 <= agg.k <= n):
+            raise ValueError(f"k must be in [1, {n}], got {agg.k}")
+    if isinstance(agg, DnC):
+        keep = n - int(agg.filter_frac * agg.num_byzantine)
+        if keep < 1:
+            raise ValueError(
+                f"DnC keeps n - filter_frac*num_byzantine = {keep} "
+                "clients; needs >= 1"
+            )
 
 
 def _pass(buf: jax.Array, c: int, init, f):
@@ -72,13 +104,12 @@ def _pass(buf: jax.Array, c: int, init, f):
     tail chunk overlaps) — accumulators must weight by it.
     """
     n, d = buf.shape
-    c, k, starts = _chunk_grid(d, c)
+    c, k, starts = chunk_grid(d, c)
 
     def body(carry, inp):
         i, start = inp
         chunk = lax.dynamic_slice(buf, (0, start), (n, c)).astype(jnp.float32)
-        new = (start + jnp.arange(c)) >= i * c
-        return f(carry, chunk, start, new), None
+        return f(carry, chunk, start, new_cols(start, i, c)), None
 
     carry, _ = lax.scan(body, init, (jnp.arange(k), starts))
     return carry
@@ -220,10 +251,7 @@ def _geomed(agg: GeoMed, buf, sq, c):
 def _multikrum(agg: Multikrum, buf, sq, c):
     n = buf.shape[0]
     f = agg.num_byzantine
-    if 2 * f + 2 > n:
-        raise ValueError(f"Too many Byzantine workers: 2*{f}+2 > {n}")
-    if not (1 <= agg.k <= n):
-        raise ValueError(f"k must be in [1, {n}], got {agg.k}")
+    check_applicable(agg, n)
     g = gram(buf, c)
     d2 = sq[:, None] + sq[None, :] - 2.0 * g
     d2 = jnp.maximum(d2, 0.0)
@@ -240,12 +268,8 @@ def _dnc(agg: DnC, buf, sq, c, key):
         raise ValueError("DnC requires a PRNG key (pass key= per round)")
     n, d = buf.shape
     sub_dim = min(agg.sub_dim, d)
+    check_applicable(agg, n)
     keep = n - int(agg.filter_frac * agg.num_byzantine)
-    if keep < 1:
-        raise ValueError(
-            f"DnC keeps n - filter_frac*num_byzantine = {keep} clients; "
-            f"needs >= 1"
-        )
 
     # Same per-iteration draws as the dense DnC, but one chunked gather
     # for ALL iterations' columns (a direct buf[:, idx] copies the matrix).

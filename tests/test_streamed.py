@@ -81,9 +81,24 @@ def test_streamed_bf16_trains(data):
     assert int(m["round"]) == 8
 
 
-def test_streamed_rejects_row_geometry():
-    with pytest.raises(NotImplementedError, match="row geometry"):
-        streamed_step(make_fr("Multikrum", "ALIE"))
+def test_streamed_rejects_unsupported_configs():
+    """Every registry aggregator now has a streamed formulation
+    (coordinate-wise or row-geometry passes); an unknown custom
+    aggregator and row-geometry FORGERS are still rejected."""
+    import dataclasses
+
+    from blades_tpu.ops.aggregators import Aggregator
+
+    @dataclasses.dataclass(frozen=True)
+    class CustomAgg(Aggregator):
+        def aggregate(self, updates):
+            return updates.mean(axis=0)
+
+    fr = make_fr("Mean")
+    fr = dataclasses.replace(fr, server=dataclasses.replace(
+        fr.server, aggregator=CustomAgg()))
+    with pytest.raises(NotImplementedError, match="streamed formulation"):
+        streamed_step(fr)
     with pytest.raises(NotImplementedError, match="row geometry"):
         streamed_step(make_fr("Median", "MinMax"))
 

@@ -138,6 +138,25 @@ def test_rowgeom_health_check_survives_nan_lane():
                jax.tree.leaves(st.server.params))
 
 
+def test_config_streamed_execution_accepts_rowgeom_aggregator():
+    """execution='streamed' at the algorithm layer drives a row-geometry
+    aggregator end-to-end."""
+    from blades_tpu.algorithms import FedavgConfig
+
+    algo = (
+        FedavgConfig()
+        .data(dataset="mnist", num_clients=8)
+        .training(global_model="mlp", server_lr=0.5,
+                  aggregator={"type": "Multikrum"}, train_batch_size=4)
+        .adversary(num_malicious_clients=2,
+                   adversary_config={"type": "IPM"})
+        .resources(execution="streamed", client_block=4)
+        .build()
+    )
+    r = algo.train()
+    assert np.isfinite(r["train_loss"])
+
+
 def test_rowgeom_rejects_ghost_lanes():
     fr, x, y, lengths, mal = _setup("GeoMed")
     fr = FedRound(task=fr.task, server=fr.server, adversary=fr.adversary,
