@@ -182,6 +182,11 @@ class Fedavg:
             return True
         if self._chunk > 1:
             return False  # multi-round fusion needs the dense program
+        from blades_tpu.adversaries.update_attacks import (
+            AttackclippedclusteringAdversary,
+            MinMaxAdversary,
+            SignGuardAdversary,
+        )
         from blades_tpu.parallel.streamed import (
             _COORDWISE_AGGREGATORS,
             _COORDWISE_FORGERS,
@@ -197,8 +202,12 @@ class Fedavg:
             _COORDWISE_AGGREGATORS + STREAMED_ROW_AGGREGATORS,
         ):
             return False
+        streamed_forgers = _COORDWISE_FORGERS + (
+            MinMaxAdversary, SignGuardAdversary,
+            AttackclippedclusteringAdversary,
+        )
         if _adv_forges(fr.adversary) and not isinstance(
-            fr.adversary, _COORDWISE_FORGERS
+            fr.adversary, streamed_forgers
         ):
             return False
         return self._dense_matrix_bytes() > self._DENSE_MATRIX_HBM_LIMIT

@@ -82,8 +82,9 @@ class ChunkInfo:
     uses GLOBAL coordinates — the same landmine ShardInfo defuses for
     width shards.  Unlike a width shard, every chunk holds FULL rows of
     its columns, and there is no cross-window reduction: row geometry is
-    not available, so ``psum`` refuses (row-geometry forgers are
-    rejected up front by the streamed path).
+    not available, so ``psum`` refuses (row-geometry FORGERS never see a
+    ChunkInfo — the streamed path runs them as full-matrix stats passes
+    instead, see streamed_geometry.forge_streamed).
 
     ``start`` and ``index`` are traced scalars (the scan carries them).
     """

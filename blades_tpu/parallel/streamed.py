@@ -28,10 +28,13 @@ pallas) finish.  The row-geometry aggregators (GeoMed, Multikrum, DnC,
 Centeredclipping, Signguard, Clippedclustering, FLTrust) run as
 full-matrix passes over the stored buffer
 (:mod:`blades_tpu.parallel.streamed_geometry`) after a materialization
-scan writes sanitize/DP/forge back into it.  Update-forging adversaries
-must be coordinate-wise (ALIE, IPM, Noise, Adaptive) — row-geometry
-FORGERS still need the d-sharded multi-chip path.  Per-row DP (clip +
-Gaussian noise) IS supported: full-row norms are taken at train time (on the f32 updates,
+scan writes sanitize/DP back into it.  Update-forging adversaries run
+either fused into the finish (coordinate-wise: ALIE, IPM, Noise,
+Adaptive) or — for the row-geometry attacks MinMax, SignGuard-attack
+and Attackclippedclustering — as stats passes producing one forged
+``(d,)`` row scattered into the malicious lanes before aggregation, so
+EVERY registry attack x defense pair runs at giant scale on one chip.
+Per-row DP (clip + Gaussian noise) IS supported: full-row norms are taken at train time (on the f32 updates,
 before storage rounding) and the chunked finish clips/noises with them —
 with f32 storage the clipping matches the dense path exactly; with bf16
 storage the clip is tightened by a half-ulp factor so the post-rounding
@@ -166,13 +169,30 @@ def streamed_step(
             f"{type(agg).__name__} has no streamed formulation; "
             "use dsharded_step on a multi-chip mesh for giant federations"
         )
-    if _adv_forges(fr.adversary) and not isinstance(fr.adversary, _COORDWISE_FORGERS):
-        raise NotImplementedError(
-            f"{type(fr.adversary).__name__} forges with row geometry; use "
-            "dsharded_step on a multi-chip mesh"
-        )
+    from blades_tpu.adversaries.update_attacks import (
+        AttackclippedclusteringAdversary,
+        MinMaxAdversary,
+        SignGuardAdversary,
+    )
+
+    _ROWGEOM_FORGERS = (MinMaxAdversary, SignGuardAdversary,
+                        AttackclippedclusteringAdversary)
     dp = fr.dp_clip_threshold is not None
-    forges = _adv_forges(fr.adversary)
+    # Coordinate-wise forgers fuse into the finish programs; row-geometry
+    # forgers run as stats passes + a scatter over the materialized
+    # buffer BEFORE aggregation (streamed_geometry.forge_streamed).
+    coord_forges = _adv_forges(fr.adversary) and isinstance(
+        fr.adversary, _COORDWISE_FORGERS
+    )
+    row_forges = _adv_forges(fr.adversary) and isinstance(
+        fr.adversary, _ROWGEOM_FORGERS
+    )
+    if _adv_forges(fr.adversary) and not (coord_forges or row_forges):
+        raise NotImplementedError(
+            f"{type(fr.adversary).__name__} has no streamed forge "
+            "formulation; use dsharded_step on a multi-chip mesh"
+        )
+    forges = coord_forges
     hooks = fr._hooks()
 
     def _dp_chunk(chunk, row_norms, k_dp, i):
@@ -417,6 +437,45 @@ def streamed_step(
         return _serve_aggregate(server_state, agg_vec, malicious, losses,
                                 sq, bad_rows, agg_state=agg_state)
 
+    @jax.jit
+    def _forge_row(updates_buf, malicious, sq, k_adv):
+        """Stats passes of a row-geometry forge -> the forged (d,) row
+        and the post-forge row squared norms."""
+        from blades_tpu.parallel.streamed_geometry import forge_streamed
+
+        forged = forge_streamed(
+            fr.adversary, updates_buf, malicious, sq, k_adv, agg,
+            min(d_chunk, updates_buf.shape[1]),
+        )
+        sq = jnp.where(malicious, forged @ forged, sq)
+        return forged, sq
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def _scatter_chunk(updates_buf, forged, malicious, start):
+        """Write the forged row's columns into the malicious lanes of one
+        chunk of the DONATED buffer (idempotent on the overlap tail)."""
+        n, d = updates_buf.shape
+        c = min(d_chunk, d)
+        fs = lax.dynamic_slice(forged, (start,), (c,))
+        chunk = lax.dynamic_slice(updates_buf, (0, start), (n, c))
+        chunk = jnp.where(malicious[:, None],
+                          fs[None, :].astype(chunk.dtype), chunk)
+        return lax.dynamic_update_slice(updates_buf, chunk, (0, start))
+
+    @jax.jit
+    def _coordwise_after_forge(server_state, updates_buf, malicious, losses,
+                               sq, bad_rows):
+        """Coordinate-wise aggregation over a buffer whose forge was
+        already materialized (row-geometry attacker + Mean/Median/
+        Trimmedmean)."""
+        from blades_tpu.parallel.streamed_geometry import aggregate_coordwise
+
+        agg_vec = aggregate_coordwise(
+            agg, updates_buf, min(d_chunk, updates_buf.shape[1])
+        )
+        return _serve_aggregate(server_state, agg_vec, malicious, losses,
+                                sq, bad_rows)
+
     d_model = None  # resolved from params on first call
 
     def step(state: RoundState, data_x, data_y, lengths, malicious, key):
@@ -424,7 +483,7 @@ def streamed_step(
         n = data_x.shape[0]
         if n % client_block:
             raise ValueError(f"{n} clients not divisible by block {client_block}")
-        if row_geom:
+        if row_geom or row_forges:
             # Checked BEFORE training: the round below donates the
             # caller's opt state and burns a full training pass.
             if fr.num_clients is not None and fr.num_clients != n:
@@ -474,14 +533,14 @@ def streamed_step(
             )
             losses.append(loss)
             norms.append(blk_norms)
-        if row_geom:
-            if _rowgeom_rewrites:
-                from blades_tpu.parallel.streamed_geometry import chunk_grid
+        if row_geom or row_forges:
+            from blades_tpu.parallel.streamed_geometry import chunk_grid
 
+            c, k_chunks, _ = chunk_grid(d_model, d_chunk)
+            if _rowgeom_rewrites:
                 sq = jnp.zeros((n,), jnp.float32)
                 bad = jnp.zeros((n,), bool)
                 cat_norms = jnp.concatenate(norms)
-                c, k_chunks, _ = chunk_grid(d_model, d_chunk)
                 for i in range(k_chunks):
                     updates_buf, sq, bad = _rowgeom_mat_chunk(
                         updates_buf, sq, bad, malicious, cat_norms,
@@ -491,10 +550,25 @@ def streamed_step(
             else:
                 sq = _rowgeom_sq(updates_buf)
                 bad = jnp.zeros((n,), bool)
-            server, metrics = _rowgeom_aggregate(
-                state.server, updates_buf, malicious, jnp.concatenate(losses),
-                sq, bad, k_agg,
-            )
+            if row_forges:
+                # Stats passes -> forged (d,) row, then scatter it into
+                # the malicious lanes chunk by chunk (donated buffer).
+                forged, sq = _forge_row(updates_buf, malicious, sq, k_adv)
+                for i in range(k_chunks):
+                    updates_buf = _scatter_chunk(
+                        updates_buf, forged, malicious,
+                        jnp.int32(min(i * c, d_model - c)),
+                    )
+            if row_geom:
+                server, metrics = _rowgeom_aggregate(
+                    state.server, updates_buf, malicious,
+                    jnp.concatenate(losses), sq, bad, k_agg,
+                )
+            else:
+                server, metrics = _coordwise_after_forge(
+                    state.server, updates_buf, malicious,
+                    jnp.concatenate(losses), sq, bad,
+                )
         elif use_fused:
             server, metrics = _finish_fused(
                 state.server, updates_buf, malicious, jnp.concatenate(losses),

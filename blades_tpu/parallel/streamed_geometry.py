@@ -143,6 +143,21 @@ def row_dots(buf: jax.Array, v: jax.Array, c: int) -> jax.Array:
     return _pass(buf, c, jnp.zeros((buf.shape[0],), jnp.float32), f)
 
 
+def row_dots2(buf: jax.Array, v1: jax.Array, v2: jax.Array, c: int):
+    """``(buf @ v1, buf @ v2)`` in ONE pass over the matrix (the giant
+    read dominates; MinMax needs both mean- and deviation-dots)."""
+
+    def f(acc, chunk, start, new):
+        a1, a2 = acc
+        w = chunk.shape[1]
+        m1 = jnp.where(new, lax.dynamic_slice(v1, (start,), (w,)), 0.0)
+        m2 = jnp.where(new, lax.dynamic_slice(v2, (start,), (w,)), 0.0)
+        return a1 + chunk @ m1, a2 + chunk @ m2
+
+    z = jnp.zeros((buf.shape[0],), jnp.float32)
+    return _pass(buf, c, (z, z), f)
+
+
 def weighted_row_sum(buf: jax.Array, w: jax.Array, c: int) -> jax.Array:
     """``w @ buf`` (d,) — weighted sum of rows (w includes any row scale)."""
 
@@ -191,6 +206,148 @@ def gather_columns(buf: jax.Array, idx: jax.Array, c: int) -> jax.Array:
         return jnp.where(inside[None, :], vals, acc)
 
     return _pass(buf, c, jnp.zeros((buf.shape[0], m), jnp.float32), f)
+
+
+def benign_col_mean_std(buf: jax.Array, malicious: jax.Array, c: int):
+    """Per-coordinate mean and ddof=1 std over benign rows, materialized
+    as ``(d,)`` f32 vectors (one pass; same formulas as
+    :func:`blades_tpu.adversaries.base.benign_mean_std`)."""
+    w = jnp.where(malicious, 0.0, 1.0).astype(jnp.float32)
+    nb = jnp.maximum(w.sum(), 1.0)
+
+    def f(acc, chunk, start, new):
+        del new
+        mean_acc, std_acc = acc
+        m = (chunk * w[:, None]).sum(axis=0) / nb
+        v = ((chunk - m) ** 2 * w[:, None]).sum(axis=0) / jnp.maximum(nb - 1.0, 1.0)
+        return (
+            lax.dynamic_update_slice(mean_acc, m, (start,)),
+            lax.dynamic_update_slice(std_acc, jnp.sqrt(v), (start,)),
+        )
+
+    d = buf.shape[1]
+    return _pass(buf, c,
+                 (jnp.zeros((d,), jnp.float32), jnp.zeros((d,), jnp.float32)),
+                 f)
+
+
+def aggregate_coordwise(agg, buf: jax.Array, c: int) -> jax.Array:
+    """Mean/Median/Trimmedmean over the streamed buffer, chunk by chunk
+    (the aggregator's own fast paths — pallas rank select on TPU — apply
+    per chunk).  Used when a row-geometry FORGER already materialized the
+    attack into the buffer, so the coordinate-wise finish has no forging
+    left to fuse."""
+
+    def f(acc, chunk, start, new):
+        del new
+        return lax.dynamic_update_slice(acc, agg.aggregate(chunk), (start,))
+
+    return _pass(buf, c, jnp.zeros((buf.shape[1],), jnp.float32), f)
+
+
+# ---------------------------------------------------------------------------
+# row-geometry forgers: stats passes -> one forged (d,) row
+# ---------------------------------------------------------------------------
+
+
+def forge_streamed(adv, buf, malicious, sq, key, aggregator, c) -> jax.Array:
+    """Compute the forged ``(d,)`` row of a row-geometry attack against
+    the streamed buffer (the caller scatters it into malicious lanes).
+
+    Mirrors the dense ``on_updates_ready`` implementations
+    (adversaries/update_attacks.py) with the matrix geometry re-expressed
+    as passes: pairwise distances from one Gram pass, distances to the
+    forged candidate from precomputed dots (MinMax's bisection becomes
+    scalar algebra), cosine geometry from the same Gram (ACC).  Keyed
+    draws (SignGuard-attack) use the round key over the full width, so
+    they match the dense round's draws exactly.
+    """
+    from blades_tpu.adversaries.update_attacks import (
+        AttackclippedclusteringAdversary,
+        MinMaxAdversary,
+        SignGuardAdversary,
+        _negate_first_half,
+    )
+    from blades_tpu.ops.aggregators import Signguard as SignguardAgg
+
+    n = buf.shape[0]
+    benign = ~malicious
+    w = benign.astype(jnp.float32)
+
+    if isinstance(adv, MinMaxAdversary):
+        mean, dev = benign_col_mean_std(buf, malicious, c)
+        if isinstance(aggregator, SignguardAgg):
+            dev = _negate_first_half(dev)
+        g = gram(buf, c)
+        d2 = jnp.maximum(sq[:, None] + sq[None, :] - 2.0 * g, 0.0)
+        pair_ok = w[:, None] * w[None, :]
+        threshold = jnp.sqrt(jnp.maximum((d2 * pair_ok).max(), 0.0))
+        dots_mean, dots_dev = row_dots2(buf, mean, dev, c)
+        mm, md, dd = mean @ mean, mean @ dev, dev @ dev
+
+        def max_dist_to_benign(gamma):
+            # ||x_i - (mean - gamma*dev)||^2 from precomputed dots.
+            d2i = (sq - 2.0 * (dots_mean - gamma * dots_dev)
+                   + (mm - 2.0 * gamma * md + gamma**2 * dd))
+            dist = jnp.sqrt(jnp.maximum(d2i, 0.0))
+            return jnp.where(benign, dist, -jnp.inf).max()
+
+        def body(_, lohi):
+            lo, hi = lohi
+            mid = (lo + hi) / 2.0
+            ok = max_dist_to_benign(mid) < threshold
+            return jnp.where(ok, mid, lo), jnp.where(ok, hi, mid)
+
+        lo, hi = lax.fori_loop(0, adv.iters, body,
+                               (jnp.zeros(()), jnp.full((), 5.0)))
+        gamma = (lo + hi) / 2.0
+        return mean - gamma * dev
+
+    if isinstance(adv, SignGuardAdversary):
+        mean, _ = benign_col_mean_std(buf, malicious, c)
+        d = mean.shape[0]
+        pos = (mean > 0).sum()
+        neg = (mean < 0).sum()
+        k_perm, k_mag = jax.random.split(key)
+        rank = jax.random.permutation(k_perm, d)
+        u = jax.random.uniform(k_mag, (d,), jnp.float32)
+        return jnp.where(rank < pos, u, jnp.where(rank < pos + neg, -u, 0.0))
+
+    if isinstance(adv, AttackclippedclusteringAdversary):
+        mean, _ = benign_col_mean_std(buf, malicious, c)
+        norms = jnp.sqrt(jnp.maximum(sq, 0.0))
+        q = 1.0 / jnp.maximum(norms, 1e-12)
+        cos = jnp.clip(q[:, None] * q[None, :] * gram(buf, c), -1.0, 1.0)
+        dist = 1.0 - cos
+        eye = jnp.eye(n, dtype=bool)
+        pair_ok = (w[:, None] * w[None, :] > 0) & ~eye
+        dis_cross = jnp.where(pair_ok, dist, jnp.inf).min()
+        theta_cross = jnp.arccos(jnp.clip(1.0 - dis_cross, -1.0, 1.0)) - 0.1
+        big_dist = jnp.where(pair_ok | eye, dist, 2.0)
+        majority = clustering.agglomerative_majority(
+            big_dist, linkage="single") & benign
+        mean_norm = jnp.linalg.norm(mean)
+        mean_hat = mean / jnp.maximum(mean_norm, 1e-12)
+        cos2mean = row_dots(buf, mean_hat, c) * q
+        dis2mean = jnp.where(majority, 1.0 - cos2mean, -jnp.inf)
+        idx = jnp.argmax(dis2mean)
+        theta = jnp.arccos(jnp.clip(1.0 - dis2mean[idx], -1.0, 1.0))
+        theta = jnp.maximum(theta, 1e-3)
+        u_star = (
+            lax.dynamic_slice_in_dim(buf, idx, 1, axis=0)[0].astype(jnp.float32)
+            * q[idx]
+        )
+        ang = theta + theta_cross - adv.eps
+        a = jnp.cos(ang) - jnp.sin(ang) / jnp.tan(theta)
+        b = (jnp.cos(theta_cross - adv.eps)
+             + jnp.sin(theta_cross - adv.eps) / jnp.tan(theta))
+        rotated = 10.0 * (a * mean_hat + b * u_star)
+        fallback = -10.0 * mean
+        return jnp.where(theta + theta_cross >= jnp.pi, fallback, rotated)
+
+    raise NotImplementedError(
+        f"no streamed forge for {type(adv).__name__}"
+    )
 
 
 def masked_scaled_median(buf, mask, row_scale, c) -> jax.Array:

@@ -138,6 +138,25 @@ def test_rowgeom_health_check_survives_nan_lane():
                jax.tree.leaves(st.server.params))
 
 
+@pytest.mark.parametrize("adversary,aggregator", [
+    ("MinMax", "Median"),
+    ("MinMax", "Signguard"),          # SignGuard-evasion negate-half path
+    ("SignGuard", "Mean"),
+    ("Attackclippedclustering", "Clippedclustering"),
+    ("MinMax", "Multikrum"),          # rowgeom forger + rowgeom aggregator
+])
+def test_rowgeom_forgers_match_dense(adversary, aggregator):
+    """MinMax / SignGuard-attack / Attackclippedclustering forge via
+    stats passes + a scatter; whole rounds match the dense path."""
+    fr, x, y, lengths, mal = _setup(aggregator, adversary=adversary)
+    rtol = 5e-3 if adversary == "MinMax" else 2e-4
+    sd, md, ss, ms = _run_both(fr, x, y, lengths, mal)
+    for a, b in zip(jax.tree.leaves(ss.server.params),
+                    jax.tree.leaves(sd.server.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=rtol,
+                                   atol=5e-5)
+
+
 def test_config_streamed_execution_accepts_rowgeom_aggregator():
     """execution='streamed' at the algorithm layer drives a row-geometry
     aggregator end-to-end."""
