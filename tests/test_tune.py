@@ -178,3 +178,15 @@ def test_sweep_checkpoint_keep_num(tmp_path):
     tdir = tmp_path / "resumable" / "resumable_00000"
     kept = sorted(p.name for p in tdir.glob("ckpt_*"))
     assert kept == ["ckpt_000006", "ckpt_000008"]
+
+
+def test_centralized_benchmark_smoke(capsys):
+    """The standalone centralized baseline (benchmarks/main.py, ref:
+    blades/benchmarks/main.py) runs end-to-end on a tiny config."""
+    from blades_tpu.benchmarks.main import main
+
+    rc = main(["--model", "mlp", "--dataset", "mnist", "--epochs", "1",
+               "--batch-size", "32"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "test_acc" in out
