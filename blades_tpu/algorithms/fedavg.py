@@ -182,11 +182,6 @@ class Fedavg:
             return True
         if self._chunk > 1:
             return False  # multi-round fusion needs the dense program
-        from blades_tpu.adversaries.update_attacks import (
-            AttackclippedclusteringAdversary,
-            MinMaxAdversary,
-            SignGuardAdversary,
-        )
         from blades_tpu.parallel.streamed import (
             _COORDWISE_AGGREGATORS,
             _COORDWISE_FORGERS,
@@ -194,6 +189,7 @@ class Fedavg:
         )
         from blades_tpu.parallel.streamed_geometry import (
             STREAMED_ROW_AGGREGATORS,
+            streamed_row_forgers,
         )
 
         fr = self.fed_round
@@ -202,12 +198,8 @@ class Fedavg:
             _COORDWISE_AGGREGATORS + STREAMED_ROW_AGGREGATORS,
         ):
             return False
-        streamed_forgers = _COORDWISE_FORGERS + (
-            MinMaxAdversary, SignGuardAdversary,
-            AttackclippedclusteringAdversary,
-        )
         if _adv_forges(fr.adversary) and not isinstance(
-            fr.adversary, streamed_forgers
+            fr.adversary, _COORDWISE_FORGERS + streamed_row_forgers()
         ):
             return False
         return self._dense_matrix_bytes() > self._DENSE_MATRIX_HBM_LIMIT

@@ -169,14 +169,9 @@ def streamed_step(
             f"{type(agg).__name__} has no streamed formulation; "
             "use dsharded_step on a multi-chip mesh for giant federations"
         )
-    from blades_tpu.adversaries.update_attacks import (
-        AttackclippedclusteringAdversary,
-        MinMaxAdversary,
-        SignGuardAdversary,
-    )
+    from blades_tpu.parallel.streamed_geometry import streamed_row_forgers
 
-    _ROWGEOM_FORGERS = (MinMaxAdversary, SignGuardAdversary,
-                        AttackclippedclusteringAdversary)
+    _ROWGEOM_FORGERS = streamed_row_forgers()
     dp = fr.dp_clip_threshold is not None
     # Coordinate-wise forgers fuse into the finish programs; row-geometry
     # forgers run as stats passes + a scatter over the materialized

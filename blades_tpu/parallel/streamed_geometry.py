@@ -58,6 +58,21 @@ STREAMED_ROW_AGGREGATORS = (
 )
 
 
+def streamed_row_forgers():
+    """The update-forging attacks :func:`forge_streamed` covers — THE
+    registry both the round builder and the execution auto-selection
+    gate consult (a function, not a constant, to dodge an import cycle
+    with the adversaries package)."""
+    from blades_tpu.adversaries.update_attacks import (
+        AttackclippedclusteringAdversary,
+        MinMaxAdversary,
+        SignGuardAdversary,
+    )
+
+    return (MinMaxAdversary, SignGuardAdversary,
+            AttackclippedclusteringAdversary)
+
+
 def chunk_grid(d: int, c: int):
     """The streamed chunking scheme, shared by every consumer: fixed
     width ``c`` (clamped to ``d``), ``k`` chunks, starts

@@ -82,11 +82,12 @@ def test_streamed_bf16_trains(data):
 
 
 def test_streamed_rejects_unsupported_configs():
-    """Every registry aggregator now has a streamed formulation
-    (coordinate-wise or row-geometry passes); an unknown custom
-    aggregator and row-geometry FORGERS are still rejected."""
+    """Every registry aggregator AND forger now has a streamed
+    formulation; unknown custom aggregators/forgers are rejected with a
+    pointer at build time."""
     import dataclasses
 
+    from blades_tpu.adversaries.base import Adversary
     from blades_tpu.ops.aggregators import Aggregator
 
     @dataclasses.dataclass(frozen=True)
@@ -99,8 +100,16 @@ def test_streamed_rejects_unsupported_configs():
         fr.server, aggregator=CustomAgg()))
     with pytest.raises(NotImplementedError, match="streamed formulation"):
         streamed_step(fr)
-    with pytest.raises(NotImplementedError, match="row geometry"):
-        streamed_step(make_fr("Median", "MinMax"))
+
+    @dataclasses.dataclass(frozen=True)
+    class CustomForger(Adversary):
+        def on_updates_ready(self, updates, malicious, key, **kw):
+            return updates
+
+    fr = make_fr("Median")
+    fr = dataclasses.replace(fr, adversary=CustomForger())
+    with pytest.raises(NotImplementedError, match="forge"):
+        streamed_step(fr)
 
 
 def test_streamed_dp_clip_matches_dense_exactly(data):
