@@ -1,0 +1,183 @@
+"""FedSGD fast path: one merged-batch program for a whole client block.
+
+Why this exists
+---------------
+``vmap(local_round)`` over clients gives every client its own copy of the
+model parameters, so XLA lowers every convolution as a batch-grouped conv
+and keeps activations in split ``[B, H, W, G, C]`` layouts stitched
+together with copies and pads.  Profiled on a v5e (see ADR in the round-3
+notes): a 100-client ResNet-10 block costs ~118 ms vmapped vs ~65 ms for
+the *same math* on one merged ``(G*B, ...)`` batch — the grouped-conv
+weight grads themselves are fine (40-100 TF/s); it is the per-client
+*program structure* that XLA punishes.
+
+When ``num_batches_per_round == 1`` (the reference's default,
+ref: fllib/algorithms/algorithm_config.py:63) every client takes exactly
+one SGD step from the SAME incoming global params, so:
+
+- the forward pass and the data-gradient backward are client-independent
+  given per-client normalisation statistics → run them once on the merged
+  batch with *shared* weights (grouped statistics handled by
+  :class:`blades_tpu.models.layers.BatchStatsNorm`);
+- only the weight gradients are per-client → recovered through *phantom
+  parameters* (zero-valued per-client tensors added linearly to each
+  layer, see :mod:`blades_tpu.models.layers`): ``d loss_c / d phantom_c``
+  IS client ``c``'s weight gradient, and because layers are linear in
+  their weights the phantom forward is dead code.
+
+The result is mathematically identical to the vmapped path (same ops per
+client, same augmentation/hook/RNG streams) up to floating-point
+reduction order.  Models opt in via a ``grouped_safe`` attribute
+(currently the ResNet family); models with dropout keep the vmapped path
+because a merged batch would consume a different dropout stream.
+
+Reference mapping: this replaces the hot loop of
+``blades/algorithms/fedavg/fedavg.py:203-245`` (parallel client rounds)
+for the 1-local-step regime the tuned_examples actually run.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from blades_tpu.models.layers import client_grouped
+from blades_tpu.utils.tree import ravel_fn
+
+
+def make_phantoms(params: Any, groups: int, dtype=jnp.float32):
+    """Zero phantom tree mirroring ``params`` with a leading group axis."""
+    return jax.tree.map(
+        lambda p: jnp.zeros((groups,) + jnp.shape(p), dtype), params
+    )
+
+
+def supports_fedsgd(task, num_batches: int, round_begin_hook) -> bool:
+    """Static gate for the fast path (checked at trace time).
+
+    OPT-IN (``BLADES_TPU_FEDSGD=1``): profiled on a v5e, this formulation
+    is currently ~1.5x SLOWER than the vmapped path (166 vs 112 ms per
+    100-client ResNet-10 block) — the merged layout forces transposes
+    around every per-client weight-grad conv, and the phantom custom-vjps
+    break XLA's fusion in ways that cost more than the merged forward
+    saves (measured floor for the merged math alone: ~65 ms).  The
+    machinery is kept (equivalence-tested in tests/test_fedsgd.py) as the
+    substrate for a future pallas batched-dW kernel that reads the merged
+    layout directly, which is what the formulation needs to win.
+    """
+    import os
+
+    from blades_tpu.core.task import identity_round_begin_hook
+
+    if os.environ.get("BLADES_TPU_FEDSGD", "0") != "1":
+        return False
+    return (
+        num_batches == 1
+        and bool(getattr(task.model, "grouped_safe", False))
+        and round_begin_hook is identity_round_begin_hook
+    )
+
+
+def fedsgd_round(
+    task,
+    global_params,
+    opt_states,
+    batches_x,
+    batches_y,
+    client_keys,
+    malicious,
+    data_hook,
+    grad_hook,
+    round_end_hook,
+):
+    """One FedSGD step for ``G`` clients as a single merged-batch program.
+
+    Args/returns match ``vmap(task.local_round)`` over the client axis:
+    ``batches_x/y`` are ``(G, 1, B, ...)``, returns
+    ``(updates (G, d), new_opt_states, losses (G,))``.
+
+    RNG parity with :meth:`blades_tpu.core.task.Task.local_round`: per
+    client, ``split(key, 1)[0]`` then (augmenting tasks) ``split`` into
+    ``(k_aug, k_loss)`` — byte-identical augmentation draws.  The loss
+    key is unused here (grouped-safe models have no dropout).
+    """
+    from blades_tpu.data.augment import get_augmentation
+
+    g = batches_x.shape[0]
+    b = batches_x.shape[2]
+    x = batches_x[:, 0]
+    y = batches_y[:, 0]
+
+    # Per-client RNG stream, matching local_round's split discipline.
+    k0 = jax.vmap(lambda k: jax.random.split(k, 1)[0])(client_keys)
+    aug = get_augmentation(task.spec.augment)
+    if aug is not None:
+        ks = jax.vmap(jax.random.split)(k0)
+        x = jax.vmap(aug)(ks[:, 0], x)
+    x, y = jax.vmap(data_hook)(x, y, malicious)
+
+    xm = x.reshape((g * b,) + x.shape[2:])
+    ym = y.reshape((g * b,))
+
+    compute_dt = (
+        jnp.dtype(task.spec.compute_dtype)
+        if task.spec.compute_dtype is not None
+        else None
+    )
+
+    def cast(tree):
+        if compute_dt is None:
+            return tree
+        return jax.tree.map(
+            lambda p: p.astype(compute_dt)
+            if jnp.issubdtype(p.dtype, jnp.floating)
+            else p,
+            tree,
+        )
+
+    xc = xm.astype(compute_dt) if compute_dt is not None else xm
+
+    def total_loss(phantoms):
+        with client_grouped(g):
+            logits = task.model.apply(
+                {"params": cast(global_params), "phantoms": cast(phantoms)},
+                xc,
+                train=True,
+            )
+        ce = optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), ym
+        )
+        per_client = ce.reshape(g, b).mean(axis=1)
+        per_client = jnp.clip(per_client, 0.0, task.spec.loss_clamp)
+        # Sum over clients: phantoms are client-local, so d(sum)/d ph_c
+        # is exactly client c's gradient — no cross terms.
+        return per_client.sum(), per_client
+
+    # Phantoms live in compute dtype: their cotangents are the raw
+    # backward-conv outputs (bf16 under mixed precision), exactly what the
+    # vmapped path produces before its f32 cast-back — we convert once at
+    # the optimizer boundary instead of materialising an f32 grad tree.
+    phantoms = make_phantoms(
+        global_params, g, compute_dt if compute_dt is not None else jnp.float32
+    )
+    grads, losses = jax.grad(total_loss, has_aux=True)(phantoms)
+    grads = jax.tree.map(lambda a: a.astype(jnp.float32), grads)
+    grads = jax.vmap(grad_hook)(grads, malicious)
+
+    opt = task.client_optimizer()
+
+    def one_client_update(gc, oc):
+        upd, o2 = opt.update(gc, oc, global_params)
+        # update vector == ravel of the optimizer's step: for one step
+        # from shared params, p1 - p0 IS the update (local_round's
+        # ravel(p1) - ravel(p0) fixed point, without materialising p1).
+        return upd, o2
+
+    upd, opt2 = jax.vmap(one_client_update)(grads, opt_states)
+    ravel, _, _ = ravel_fn(global_params)
+    updates = jax.vmap(ravel)(upd)
+    updates = jax.vmap(round_end_hook)(updates, malicious)
+    return updates, opt2, losses

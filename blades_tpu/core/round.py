@@ -153,13 +153,9 @@ class FedRound:
         hooks = self._hooks()
         client_keys = jax.random.split(k_train, num_clients)
 
-        def one_client(opt_state, cbx, cby, ck, mal):
-            return self.task.local_round(
-                state.server.params, opt_state, cbx, cby, ck, mal, *hooks
-            )
-
-        updates, client_opt, losses = jax.vmap(one_client)(
-            state.client_opt, bx, by, client_keys, malicious
+        updates, client_opt, losses = self.task.local_round_batched(
+            state.server.params, state.client_opt, bx, by, client_keys,
+            malicious, *hooks,
         )
         # Drop ghost (padding) lanes before anything consumes the matrix.
         k = self.num_clients

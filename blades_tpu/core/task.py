@@ -207,6 +207,49 @@ class Task:
         update = round_end_hook(update, malicious)
         return update, opt_state, losses.mean()
 
+    def local_round_batched(
+        self,
+        global_params,
+        opt_states,
+        batches_x,
+        batches_y,
+        client_keys,
+        malicious,
+        data_hook: DataHook = identity_data_hook,
+        grad_hook: GradHook = identity_grad_hook,
+        round_begin_hook=identity_round_begin_hook,
+        round_end_hook=identity_round_end_hook,
+    ):
+        """A whole client block's local rounds: ``(G, nb, B, ...)`` batches
+        -> ``(updates (G, d), new_opt_states, losses (G,))``.
+
+        Semantically ``vmap(local_round)`` over the client axis.  When
+        ``BLADES_TPU_FEDSGD=1`` is set, the round is a single SGD step
+        from shared params, and the model is ``grouped_safe``, it
+        dispatches to the merged-batch FedSGD path
+        (:mod:`blades_tpu.core.fedsgd`) — same math, equivalence-tested,
+        but currently opt-in only: as profiled it is ~1.5x SLOWER than
+        the vmapped path on a v5e (see ``supports_fedsgd``); it exists
+        as the substrate for a pallas batched-dW kernel.
+        """
+        from blades_tpu.core.fedsgd import fedsgd_round, supports_fedsgd
+
+        if supports_fedsgd(self, batches_x.shape[1], round_begin_hook):
+            return fedsgd_round(
+                self, global_params, opt_states, batches_x, batches_y,
+                client_keys, malicious, data_hook, grad_hook, round_end_hook,
+            )
+
+        def one_client(opt_state, cbx, cby, ck, mal):
+            return self.local_round(
+                global_params, opt_state, cbx, cby, ck, mal,
+                data_hook, grad_hook, round_begin_hook, round_end_hook,
+            )
+
+        return jax.vmap(one_client)(
+            opt_states, batches_x, batches_y, client_keys, malicious
+        )
+
     def evaluate(self, params, x, y, mask):
         """Masked eval over one client's padded test shard.
 

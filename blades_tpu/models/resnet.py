@@ -14,7 +14,7 @@ from typing import Sequence, Type
 
 import flax.linen as nn
 
-from blades_tpu.models.layers import BatchStatsNorm
+from blades_tpu.models.layers import BatchStatsNorm, Conv, Dense
 
 
 class BasicBlock(nn.Module):
@@ -25,12 +25,12 @@ class BasicBlock(nn.Module):
     @nn.compact
     def __call__(self, x):
         residual = x
-        y = nn.Conv(self.filters, (3, 3), strides=self.stride, padding=1, use_bias=False)(x)
+        y = Conv(self.filters, (3, 3), strides=self.stride, padding=1, use_bias=False)(x)
         y = nn.relu(BatchStatsNorm()(y))
-        y = nn.Conv(self.filters, (3, 3), padding=1, use_bias=False)(y)
+        y = Conv(self.filters, (3, 3), padding=1, use_bias=False)(y)
         y = BatchStatsNorm()(y)
         if self.stride != 1 or x.shape[-1] != self.filters * self.expansion:
-            residual = nn.Conv(
+            residual = Conv(
                 self.filters * self.expansion, (1, 1), strides=self.stride, use_bias=False
             )(x)
             residual = BatchStatsNorm()(residual)
@@ -45,14 +45,14 @@ class Bottleneck(nn.Module):
     @nn.compact
     def __call__(self, x):
         residual = x
-        y = nn.Conv(self.filters, (1, 1), use_bias=False)(x)
+        y = Conv(self.filters, (1, 1), use_bias=False)(x)
         y = nn.relu(BatchStatsNorm()(y))
-        y = nn.Conv(self.filters, (3, 3), strides=self.stride, padding=1, use_bias=False)(y)
+        y = Conv(self.filters, (3, 3), strides=self.stride, padding=1, use_bias=False)(y)
         y = nn.relu(BatchStatsNorm()(y))
-        y = nn.Conv(self.filters * self.expansion, (1, 1), use_bias=False)(y)
+        y = Conv(self.filters * self.expansion, (1, 1), use_bias=False)(y)
         y = BatchStatsNorm()(y)
         if self.stride != 1 or x.shape[-1] != self.filters * self.expansion:
-            residual = nn.Conv(
+            residual = Conv(
                 self.filters * self.expansion, (1, 1), strides=self.stride, use_bias=False
             )(x)
             residual = BatchStatsNorm()(residual)
@@ -64,10 +64,15 @@ class ResNet(nn.Module):
     stage_sizes: Sequence[int]
     num_classes: int = 10
 
+    # No dropout/stochastic depth and every parametric layer is
+    # group-aware (layers.Conv/Dense/BatchStatsNorm), so the FedSGD
+    # merged-batch fast path (core/fedsgd.py) is exact for this family.
+    grouped_safe: bool = True
+
     @nn.compact
     def __call__(self, x, *, train: bool = False):
         del train  # no dropout / no mutable norm state
-        x = nn.Conv(64, (3, 3), padding=1, use_bias=False)(x)
+        x = Conv(64, (3, 3), padding=1, use_bias=False)(x)
         x = nn.relu(BatchStatsNorm()(x))
         for i, num_blocks in enumerate(self.stage_sizes):
             filters = 64 * 2**i
@@ -75,7 +80,7 @@ class ResNet(nn.Module):
                 stride = 2 if i > 0 and j == 0 else 1
                 x = self.block(filters, stride)(x)
         x = x.mean(axis=(1, 2))
-        return nn.Dense(self.num_classes)(x)
+        return Dense(self.num_classes)(x)
 
 
 def ResNet10(num_classes: int = 10) -> ResNet:

@@ -292,13 +292,9 @@ def dsharded_step(fr: FedRound, mesh: Mesh) -> Callable:
         hooks = fr._hooks()
         client_keys = jax.random.split(k_train, n_local)
 
-        def one_client(opt_state, cbx, cby, ck, mal):
-            return fr.task.local_round(
-                state.server.params, opt_state, cbx, cby, ck, mal, *hooks
-            )
-
-        upd_local, client_opt, losses_local = jax.vmap(one_client)(
-            state.client_opt, bx, by, client_keys, malicious
+        upd_local, client_opt, losses_local = fr.task.local_round_batched(
+            state.server.params, state.client_opt, bx, by, client_keys,
+            malicious, *hooks,
         )
         upd_local = fr.apply_dp(
             upd_local, jax.random.fold_in(k_dp, lax.axis_index(AXIS))

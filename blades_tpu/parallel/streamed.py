@@ -223,13 +223,8 @@ def streamed_step(
             fr.num_batches_per_round,
         )
 
-        def one_client(opt_state, cbx, cby, ck, mal):
-            return fr.task.local_round(
-                params, opt_state, cbx, cby, ck, mal, *hooks
-            )
-
-        upd, opt2, loss = jax.vmap(one_client)(
-            opt_b, bx, by, sl(train_keys), sl(malicious)
+        upd, opt2, loss = fr.task.local_round_batched(
+            params, opt_b, bx, by, sl(train_keys), sl(malicious), *hooks
         )
         # Full-row L2 norms, taken on the f32 updates BEFORE storage-dtype
         # rounding — what chunked DP clipping needs and cannot recover

@@ -1,0 +1,155 @@
+"""FedSGD merged-batch fast path vs vmapped local_round equivalence.
+
+The fast path (core/fedsgd.py) must reproduce the vmapped per-client
+round — same updates, losses, and opt states — up to floating-point
+reduction order (the grouped program sums in a different association).
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _enable_fast_path(monkeypatch):
+    """The fast path is opt-in; enable it for this module only (the flag
+    is read per call, so monkeypatch scoping is enough)."""
+    monkeypatch.setenv("BLADES_TPU_FEDSGD", "1")
+
+from blades_tpu.core.fedsgd import supports_fedsgd
+from blades_tpu.core.task import (
+    TaskSpec,
+    identity_data_hook,
+    identity_grad_hook,
+    identity_round_begin_hook,
+    identity_round_end_hook,
+)
+
+G, B = 4, 4
+
+
+def _mk(task, key=0, nb=1):
+    params = task.init_params(jax.random.PRNGKey(key))
+    opt0 = task.init_client_opt_state(params)
+    opts = jax.tree.map(lambda a: jnp.broadcast_to(a, (G,) + a.shape), opt0)
+    rng = np.random.default_rng(key)
+    bx = jnp.asarray(rng.normal(size=(G, nb, B, 32, 32, 3)), jnp.float32)
+    by = jnp.asarray(rng.integers(0, 10, size=(G, nb, B)), jnp.int32)
+    keys = jax.random.split(jax.random.PRNGKey(key + 1), G)
+    return params, opts, bx, by, keys
+
+
+def _vmapped(task, params, opts, bx, by, keys, mal, hooks=None):
+    h = hooks or (identity_data_hook, identity_grad_hook,
+                  identity_round_begin_hook, identity_round_end_hook)
+
+    def one(o, cbx, cby, k, m):
+        return task.local_round(params, o, cbx, cby, k, m, *h)
+
+    return jax.vmap(one)(opts, bx, by, keys, mal)
+
+
+def _fast(task, params, opts, bx, by, keys, mal, hooks=None):
+    h = hooks or (identity_data_hook, identity_grad_hook,
+                  identity_round_begin_hook, identity_round_end_hook)
+    assert supports_fedsgd(task, bx.shape[1], h[2]), "fast path not taken"
+    return task.local_round_batched(params, opts, bx, by, keys, mal, *h)
+
+
+def _check(task, mal=None, hooks=None, atol=2e-5):
+    params, opts, bx, by, keys = _mk(task)
+    if mal is None:
+        mal = jnp.zeros((G,), bool)
+    u_ref, o_ref, l_ref = jax.jit(
+        lambda *a: _vmapped(task, *a, hooks=hooks)
+    )(params, opts, bx, by, keys, mal)
+    u_fast, o_fast, l_fast = jax.jit(
+        lambda *a: _fast(task, *a, hooks=hooks)
+    )(params, opts, bx, by, keys, mal)
+    np.testing.assert_allclose(np.asarray(l_fast), np.asarray(l_ref),
+                               atol=atol, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(u_fast), np.asarray(u_ref),
+                               atol=atol, rtol=1e-3)
+    for a, b in zip(jax.tree.leaves(o_fast), jax.tree.leaves(o_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=atol, rtol=1e-3)
+
+
+def test_resnet_plain():
+    task = TaskSpec(model="resnet10", input_shape=(32, 32, 3),
+                    num_classes=10, lr=0.1).build()
+    _check(task)
+
+
+def test_resnet_momentum_and_augment():
+    task = TaskSpec(model="resnet10", input_shape=(32, 32, 3),
+                    num_classes=10, lr=0.1, momentum=0.9,
+                    augment="cifar").build()
+    _check(task)
+
+
+def test_resnet_hooks_and_malicious():
+    from blades_tpu.adversaries.training_attacks import (
+        LabelFlipAdversary,
+        SignFlipAdversary,
+    )
+
+    task = TaskSpec(model="resnet10", input_shape=(32, 32, 3),
+                    num_classes=10, lr=0.1).build()
+    lf = LabelFlipAdversary(num_classes=10)
+    sf = SignFlipAdversary()
+    mal = jnp.array([True, True, False, False])
+
+    hooks = (lf.data_hook, sf.grad_hook,
+             identity_round_begin_hook, identity_round_end_hook)
+    _check(task, mal=mal, hooks=hooks)
+
+
+def test_round_end_hook_applies():
+    task = TaskSpec(model="resnet10", input_shape=(32, 32, 3),
+                    num_classes=10, lr=0.1).build()
+
+    def double_end(update, malicious):
+        return jnp.where(malicious, 2.0 * update, update)
+
+    mal = jnp.array([True, False, False, False])
+    hooks = (identity_data_hook, identity_grad_hook,
+             identity_round_begin_hook, double_end)
+    _check(task, mal=mal, hooks=hooks)
+
+
+def test_fallbacks():
+    # dropout model (MLP) is not grouped_safe
+    mlp = TaskSpec(model="mlp", input_shape=(28, 28, 1), num_classes=10).build()
+    assert not supports_fedsgd(mlp, 1, identity_round_begin_hook)
+    # multi-batch rounds fall back
+    rn = TaskSpec(model="resnet10", input_shape=(32, 32, 3)).build()
+    assert not supports_fedsgd(rn, 2, identity_round_begin_hook)
+    # opt-in switch: off unless the env flag is exactly "1"
+    os.environ["BLADES_TPU_FEDSGD"] = "0"  # monkeypatched; auto-restored
+    assert not supports_fedsgd(rn, 1, identity_round_begin_hook)
+    os.environ["BLADES_TPU_FEDSGD"] = "1"
+    # non-identity round-begin hook falls back
+    assert not supports_fedsgd(rn, 1, lambda p, o, m: (p, o))
+
+
+def test_multibatch_fallback_matches_vmap():
+    """nb=2 routes through vmap(local_round) — identical by construction."""
+    task = TaskSpec(model="resnet10", input_shape=(32, 32, 3), lr=0.1).build()
+    params, opts, _, _, keys = _mk(task)
+    rng = np.random.default_rng(7)
+    bx = jnp.asarray(rng.normal(size=(G, 2, B, 32, 32, 3)), jnp.float32)
+    by = jnp.asarray(rng.integers(0, 10, size=(G, 2, B)), jnp.int32)
+    mal = jnp.zeros((G,), bool)
+    u_ref, _, _ = _vmapped(task, params, opts, bx, by, keys, mal)
+    u_b, _, _ = task.local_round_batched(params, opts, bx, by, keys, mal)
+    np.testing.assert_array_equal(np.asarray(u_ref), np.asarray(u_b))
+
+
+def test_bf16_fast_path_close():
+    task = TaskSpec(model="resnet10", input_shape=(32, 32, 3), lr=0.1,
+                    compute_dtype="bfloat16").build()
+    _check(task, atol=5e-3)
