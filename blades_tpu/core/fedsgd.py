@@ -127,17 +127,7 @@ def fedsgd_round(
         if task.spec.compute_dtype is not None
         else None
     )
-
-    def cast(tree):
-        if compute_dt is None:
-            return tree
-        return jax.tree.map(
-            lambda p: p.astype(compute_dt)
-            if jnp.issubdtype(p.dtype, jnp.floating)
-            else p,
-            tree,
-        )
-
+    cast = task.cast_to_compute
     xc = xm.astype(compute_dt) if compute_dt is not None else xm
 
     def total_loss(phantoms):
@@ -168,15 +158,12 @@ def fedsgd_round(
     grads = jax.vmap(grad_hook)(grads, malicious)
 
     opt = task.client_optimizer()
-
-    def one_client_update(gc, oc):
-        upd, o2 = opt.update(gc, oc, global_params)
-        # update vector == ravel of the optimizer's step: for one step
-        # from shared params, p1 - p0 IS the update (local_round's
-        # ravel(p1) - ravel(p0) fixed point, without materialising p1).
-        return upd, o2
-
-    upd, opt2 = jax.vmap(one_client_update)(grads, opt_states)
+    # update vector == ravel of the optimizer's step: for one step from
+    # shared params, p1 - p0 IS the update (local_round's
+    # ravel(p1) - ravel(p0) fixed point, without materialising p1).
+    upd, opt2 = jax.vmap(lambda gc, oc: opt.update(gc, oc, global_params))(
+        grads, opt_states
+    )
     ravel, _, _ = ravel_fn(global_params)
     updates = jax.vmap(ravel)(upd)
     updates = jax.vmap(round_end_hook)(updates, malicious)

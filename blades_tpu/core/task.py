@@ -109,13 +109,22 @@ class Task:
         rngs = {"dropout": dropout_key} if dropout_key is not None else None
         return self.model.apply({"params": params}, x, train=train, rngs=rngs)
 
+    def cast_to_compute(self, tree):
+        """Cast floating leaves to ``spec.compute_dtype`` (identity when no
+        mixed precision is configured).  The single source of the casting
+        rule for both the vmapped path and the FedSGD fast path."""
+        if self.spec.compute_dtype is None:
+            return tree
+        dt = jnp.dtype(self.spec.compute_dtype)
+        return jax.tree.map(
+            lambda p: p.astype(dt) if jnp.issubdtype(p.dtype, jnp.floating) else p,
+            tree,
+        )
+
     def loss_fn(self, params, x, y, dropout_key=None):
         if self.spec.compute_dtype is not None:
             dt = jnp.dtype(self.spec.compute_dtype)
-            params = jax.tree.map(
-                lambda p: p.astype(dt) if jnp.issubdtype(p.dtype, jnp.floating) else p,
-                params,
-            )
+            params = self.cast_to_compute(params)
             x = x.astype(dt)
         logits = self.apply(params, x, train=True, dropout_key=dropout_key)
         ce = optax.softmax_cross_entropy_with_integer_labels(
