@@ -240,8 +240,12 @@ class FedavgConfig:
             )
         if self.num_malicious_clients > 0 and not self.adversary_config:
             raise ValueError("num_malicious_clients > 0 requires adversary_config")
-        name = self.dataset if isinstance(self.dataset, str) else getattr(
-            self.dataset, "name", None)
+        if isinstance(self.dataset, str):
+            name = self.dataset
+        elif isinstance(self.dataset, dict):
+            name = self.dataset.get("type")  # catalog dict spec
+        else:
+            name = getattr(self.dataset, "name", None)
         name = name.lower() if isinstance(name, str) else None
         if self.input_shape is None:
             if name in _INPUT_SHAPES:

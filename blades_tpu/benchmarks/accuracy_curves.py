@@ -30,12 +30,19 @@ MODELS = {"mnist": "mlp", "fashionmnist": "cnn", "cifar10": "resnet10",
 
 
 def run_cell(dataset, model, aggregator, num_malicious, adversary, rounds,
-             seed, num_clients, chunk):
+             seed, num_clients, chunk, iid=True, alpha=0.1,
+             synthetic_noise=0.5):
     from blades_tpu.algorithms import FedavgConfig
 
+    spec = dataset
+    if synthetic_noise != 0.5:
+        # Difficulty dial for the synthetic fallback (real raw data
+        # ignores it): see datasets._synthetic_classification.
+        spec = {"type": dataset, "synthetic_noise": synthetic_noise}
     cfg = (
         FedavgConfig()
-        .data(dataset=dataset, num_clients=num_clients, iid=True, seed=seed)
+        .data(dataset=spec, num_clients=num_clients, iid=iid,
+              dirichlet_alpha=alpha, seed=seed)
         .training(global_model=model,
                   aggregator={"type": aggregator}, server_lr=1.0)
         .adversary(
@@ -80,6 +87,13 @@ def main(argv=None) -> int:
     p.add_argument("--rounds-per-dispatch", type=int, default=10)
     p.add_argument("--out", default="curves_out")
     p.add_argument("--seed", type=int, default=122)
+    p.add_argument("--noniid-alpha", type=float, default=None,
+                   help="partition non-IID with this Dirichlet alpha "
+                   "(default: IID, the historical behavior)")
+    p.add_argument("--synthetic-noise", type=float, default=0.5,
+                   help="difficulty of the synthetic fallback (no effect "
+                   "on real data); ~3.0 makes attack/defense orderings "
+                   "visible on cifar10/resnet10, ~8.0 on mnist/mlp")
     args = p.parse_args(argv)
 
     model = args.model or MODELS.get(args.dataset, "mlp")
@@ -97,6 +111,8 @@ def main(argv=None) -> int:
             "dataset": args.dataset, "model": model,
             "adversary": args.adversary, "rounds": args.rounds,
             "num_clients": args.num_clients,
+            "noniid_alpha": args.noniid_alpha,
+            "synthetic_noise": args.synthetic_noise,
             "complete": len(rows) == len(args.aggregators) * len(args.malicious),
             "rows": rows,
         }
@@ -108,7 +124,10 @@ def main(argv=None) -> int:
             t0 = time.perf_counter()
             row = run_cell(args.dataset, model, agg, m, args.adversary,
                            args.rounds, args.seed, args.num_clients,
-                           args.rounds_per_dispatch)
+                           args.rounds_per_dispatch,
+                           iid=args.noniid_alpha is None,
+                           alpha=args.noniid_alpha or 0.1,
+                           synthetic_noise=args.synthetic_noise)
             row["wall_s"] = round(time.perf_counter() - t0, 1)
             rows.append(row)
             print(json.dumps(row), flush=True)

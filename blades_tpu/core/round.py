@@ -256,7 +256,11 @@ class FedRound:
         from blades_tpu.ops import masked as _masked
 
         clipped = _masked.clip_rows_to_norm(updates, self.dp_clip_threshold)
-        if self.dp_noise_factor:
+        # `is not None` (not truthiness): under experiment lanes
+        # (tune/lanes.py) the noise factor is a traced per-lane scalar,
+        # which cannot be bool()ed; a concrete 0.0 adds exactly zero noise
+        # either way.
+        if self.dp_noise_factor is not None:
             sigma = self.dp_noise_factor * self.dp_clip_threshold
             noise = sigma * jax.random.normal(key, updates.shape, updates.dtype)
             clipped = clipped + noise

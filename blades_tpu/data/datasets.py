@@ -146,20 +146,27 @@ def _synthetic_classification(
     input_shape: Tuple[int, ...],
     num_classes: int,
     seed: int,
+    noise: float = 0.5,
 ) -> Tuple[np.ndarray, ...]:
     """Deterministic learnable synthetic data: class-dependent means + noise.
 
     Each class c gets a fixed random direction mu_c; samples are
-    ``mu_c + 0.5 * noise`` so simple models reach high accuracy quickly —
+    ``mu_c + noise * eps`` so simple models reach high accuracy quickly —
     which is what integration tests need (the reference's SimpleDataset
     plays the same role, ref: blades/algorithms/fedavg/tests/test_fedavg.py:26-55).
+
+    ``noise`` (default 0.5, the historical value) is the difficulty dial:
+    at 0.5 the task is so separable that no update-forging attack can
+    dent any aggregator; the robustness harness
+    (:mod:`blades_tpu.benchmarks.accuracy_curves`) raises it (Bayes error
+    grows with ``noise``) so attack/defense orderings become visible.
     """
     rng = np.random.default_rng(seed)
     mus = rng.normal(0.0, 1.0, size=(num_classes,) + input_shape).astype(np.float32)
 
     def make(n):
         y = rng.integers(0, num_classes, size=n).astype(np.int32)
-        x = mus[y] + 0.5 * rng.normal(0.0, 1.0, size=(n,) + input_shape).astype(np.float32)
+        x = mus[y] + noise * rng.normal(0.0, 1.0, size=(n,) + input_shape).astype(np.float32)
         return x.astype(np.float32), y
 
     tx, ty = make(n_train)
@@ -196,6 +203,7 @@ def _build_image_dataset(
     train_frac: float,
     synth_train: int,
     synth_test: int,
+    synth_noise: float = 0.5,
 ) -> FLDataset:
     raw = loader()
     synthetic = raw is None
@@ -208,7 +216,8 @@ def _build_image_dataset(
         synth_train = max(synth_train, num_clients * 50)
         synth_test = max(synth_test, num_clients * 10)
         tx, ty, vx, vy = _synthetic_classification(
-            synth_train, synth_test, input_shape, num_classes, seed=synth_seed
+            synth_train, synth_test, input_shape, num_classes,
+            seed=synth_seed, noise=synth_noise,
         )
     else:
         tx, ty, vx, vy = raw
@@ -237,6 +246,7 @@ def build_mnist(num_clients=60, iid=True, alpha=0.1, seed=0, **kw) -> FLDataset:
         lambda x: _norm_gray(x, MNIST_MEAN, MNIST_STD)[..., None],
         (28, 28, 1), 10, num_clients, iid, alpha, seed,
         kw.get("train_frac", 1.0), 6000, 1000,
+        synth_noise=kw.get("synthetic_noise", 0.5),
     )
 
 
@@ -246,6 +256,7 @@ def build_fashionmnist(num_clients=60, iid=True, alpha=0.1, seed=0, **kw) -> FLD
         lambda x: _norm_gray(x, FMNIST_MEAN, FMNIST_STD)[..., None],
         (28, 28, 1), 10, num_clients, iid, alpha, seed,
         kw.get("train_frac", 1.0), 6000, 1000,
+        synth_noise=kw.get("synthetic_noise", 0.5),
     )
 
 
@@ -257,6 +268,7 @@ def build_cifar10(num_clients=60, iid=True, alpha=0.1, seed=0, **kw) -> FLDatase
         "cifar10", _load_cifar10, norm,
         (32, 32, 3), 10, num_clients, iid, alpha, seed,
         kw.get("train_frac", 1.0), 5000, 1000,
+        synth_noise=kw.get("synthetic_noise", 0.5),
     )
 
 
@@ -268,6 +280,7 @@ def build_cifar100(num_clients=60, iid=True, alpha=0.1, seed=0, **kw) -> FLDatas
         "cifar100", _load_cifar100, norm,
         (32, 32, 3), 100, num_clients, iid, alpha, seed,
         kw.get("train_frac", 1.0), 5000, 1000,
+        synth_noise=kw.get("synthetic_noise", 0.5),
     )
 
 

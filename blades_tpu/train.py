@@ -47,6 +47,10 @@ def main(argv=None) -> int:
                         "JAX_COORDINATOR_ADDRESS")
     p_file.add_argument("--num-processes", type=int, default=None)
     p_file.add_argument("--process-id", type=int, default=None)
+    p_file.add_argument("--no-lanes", action="store_true",
+                        help="disable vmapped lane execution of shape-"
+                        "compatible trial groups (seed/lr/eps/scale grids); "
+                        "every trial then runs sequentially")
     p_file.add_argument("--trace", default=None, metavar="DIR",
                         help="capture a jax profiler trace into DIR "
                         "(the reference's --trace flag is dead code; this "
@@ -86,6 +90,7 @@ def main(argv=None) -> int:
                 resume=args.resume,
                 max_rounds_override=args.max_rounds,
                 max_failures=args.max_failures,
+                lanes=not args.no_lanes,
             )
 
         if args.trace:

@@ -1,91 +1,216 @@
-"""Experiment-parallelism: seed-replicate trials as vmapped lanes.
+"""Experiment-parallelism: shape-compatible trials as vmapped lanes.
 
 The reference runs Tune trials concurrently across a Ray cluster
-(SURVEY.md §2.9, ref: blades/train.py:380-386).  On TPU the analogue for
-the canonical seed sweep (``seed: grid_search: [121..125]``, ref:
-fedavg_dp.yaml:7-9) is ONE jit program with a leading trial axis: every
-trial shares shapes and static config (model, aggregator, adversary), so
-the whole federated round vmaps over (per-seed state, per-seed data
-partition, per-seed key stream) and L trials cost one dispatch per round
-instead of L.
+(SURVEY.md §2.9, ref: blades/train.py:380-386).  On TPU the analogue is
+ONE jit program with a leading trial axis: trials that share every
+*static* config knob (model, aggregator type, adversary type, client
+count, batch size...) but differ in **lane-traceable** knobs run as
+vmapped lanes, so L trials cost one dispatch per round instead of L.
+
+Lane-traceable knobs (``LANE_KEYS``):
+
+- ``seed`` — per-lane data partition + PRNG key stream;
+- ``client_lr`` / ``server_lr`` — become traced scalars inside the optax
+  transforms (constructed per-trace, so a tracer flows through);
+- ``dp_epsilon`` / ``dp_clip_threshold`` / ``dp_noise_factor`` — the DP
+  grid (ref: fedavg_dp.yaml:15-16 sweeps eps over {1,10,100});
+- ``adversary_scale`` — IPM's scale knob (ref:
+  fedavg_cifar10_resnet_noniid.yaml sweeps IPM 0.1 vs 100).
 
 Per-lane RNG mirrors the sequential driver exactly — lane i carries the
 key stream of ``PRNGKey(seed_i)`` with the same split discipline as
-``Fedavg`` — so a vmapped lane reproduces its sequential trial.
+``Fedavg`` — so a vmapped lane reproduces its sequential trial (within
+vmap's floating-point reduction-order tolerance).
+
+:func:`run_seed_lanes` (round 2's API) is the seed-only special case.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List
+import dataclasses
+from typing import Callable, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+# Flat FedavgConfig field names a lane may vary.  "seed" affects data and
+# RNG; the rest become traced scalars threaded through dataclasses.replace
+# on the FedRound (see _apply_lane).
+LANE_KEYS = ("seed", "client_lr", "server_lr", "dp_epsilon",
+             "dp_clip_threshold", "dp_noise_factor", "adversary_scale")
 
-def run_seed_lanes(config, seeds: List[int], max_rounds: int) -> List[List[Dict]]:
-    """Run one trial per seed as vmapped lanes of a single program.
+
+def _apply_lane(fr, sc: Dict[str, jax.Array]):
+    """Rebuild a FedRound with this lane's traced scalars.
+
+    Runs INSIDE the vmapped trace: the replaced fields hold tracers, so
+    each lane computes with its own values while sharing one program.
+    Only fields consumed arithmetically may be laned — structural gates
+    (momentum on/off, DP on/off, adversary type) stay static and are
+    enforced by the grouping logic in :func:`lane_groups`.
+    """
+    task, server, adv = fr.task, fr.server, fr.adversary
+    if "client_lr" in sc:
+        task = dataclasses.replace(
+            task, spec=dataclasses.replace(task.spec, lr=sc["client_lr"])
+        )
+    if "server_lr" in sc:
+        server = dataclasses.replace(server, lr=sc["server_lr"])
+    if "adversary_scale" in sc:
+        adv = dataclasses.replace(adv, scale=sc["adversary_scale"])
+    kw = {}
+    if "dp_clip_threshold" in sc:
+        kw["dp_clip_threshold"] = sc["dp_clip_threshold"]
+    if "dp_noise_factor" in sc:
+        kw["dp_noise_factor"] = sc["dp_noise_factor"]
+    return dataclasses.replace(fr, task=task, server=server, adversary=adv, **kw)
+
+
+def run_lanes(
+    config_builder: Callable[[], "FedavgConfig"],
+    lane_overrides: List[Dict],
+    max_rounds: int,
+) -> List[List[Dict]]:
+    """Run one trial per lane-override dict as vmapped lanes of a single
+    program.
 
     Args:
-        config: a built-up (not yet frozen) ``FedavgConfig``; its ``seed``
-            field is overridden per lane.
-        seeds: one trial per entry.
+        config_builder: zero-arg callable returning a fresh, un-frozen
+            config with the group's SHARED settings applied.
+        lane_overrides: per lane, a dict of ``LANE_KEYS`` (flat config
+            field names) to that lane's value.  Keys must be identical
+            across lanes (one program).
         max_rounds: FL rounds per trial.
 
     Returns:
-        Per seed, the list of per-round result dicts (Tune's
-        ``result.json`` rows: training_iteration, train_loss, test_acc...).
+        Per lane, the list of per-round result dicts (Tune's
+        ``result.json`` rows).
     """
     from blades_tpu.adversaries import make_malicious_mask
     from blades_tpu.data import DatasetCatalog
 
-    config.validate()
-    fr = config.get_fed_round()
-    L = len(seeds)
+    L = len(lane_overrides)
+    keys_set = {frozenset(o.keys()) for o in lane_overrides}
+    if len(keys_set) != 1:
+        raise ValueError("all lanes must override the same keys")
+    unknown = set(next(iter(keys_set))) - set(LANE_KEYS)
+    if unknown:
+        raise ValueError(f"not lane-traceable: {sorted(unknown)}")
 
-    # Per-seed data partitions, stacked on a leading lane axis.
-    stacks = {"x": [], "y": [], "ln": [], "tx": [], "ty": [], "tln": []}
-    for s in seeds:
-        ds = DatasetCatalog.get_dataset(
-            config.dataset, num_clients=config.num_clients, iid=config.iid,
-            alpha=config.dirichlet_alpha, seed=s,
+    # Per-lane configs (cheap: validate only) — the source of seeds and of
+    # derived scalars like FedavgDPConfig's noise factor.
+    cfgs = []
+    for o in lane_overrides:
+        c = config_builder()
+        for k, v in o.items():
+            if k == "adversary_scale":
+                ac = dict(c.adversary_config or {})
+                ac["scale"] = v
+                c.adversary_config = ac
+            else:
+                setattr(c, k, v)
+        c.validate()
+        cfgs.append(c)
+    base = cfgs[0]
+    fr = base.get_fed_round()
+    if getattr(fr.server.aggregator, "expects_trusted_row", False):
+        raise ValueError("trust-bootstrapped aggregators are not lane-able")
+
+    seeds = [c.seed for c in cfgs]
+    # Traced scalar lanes, one per overridden knob (seed is handled via
+    # data/keys; dp_epsilon reaches the program as the derived noise
+    # factor validate() computed).
+    ok = next(iter(keys_set))
+
+    def arr(field):
+        return jnp.asarray([float(getattr(c, field)) for c in cfgs],
+                           jnp.float32)
+
+    sc = {}
+    if "client_lr" in ok:
+        sc["client_lr"] = arr("client_lr")
+    if "server_lr" in ok:
+        sc["server_lr"] = arr("server_lr")
+    if "dp_epsilon" in ok or "dp_noise_factor" in ok:
+        sc["dp_noise_factor"] = arr("dp_noise_factor")
+    if "dp_clip_threshold" in ok:
+        sc["dp_clip_threshold"] = arr("dp_clip_threshold")
+    if "adversary_scale" in ok:
+        sc["adversary_scale"] = jnp.asarray(
+            [float(c.adversary_config["scale"]) for c in cfgs], jnp.float32
         )
-        stacks["x"].append(ds.train.x)
-        stacks["y"].append(ds.train.y)
-        stacks["ln"].append(ds.train.lengths)
-        stacks["tx"].append(ds.test.x)
-        stacks["ty"].append(ds.test.y)
-        stacks["tln"].append(ds.test.lengths)
-    # Shard sizes can differ per seed under Dirichlet; pad to the widest.
-    def stack(arrs):
-        cap = max(a.shape[1] for a in arrs) if arrs[0].ndim > 1 else None
-        if cap is not None:
-            arrs = [
-                np.pad(a, [(0, 0), (0, cap - a.shape[1])] + [(0, 0)] * (a.ndim - 2))
-                for a in arrs
-            ]
-        return jnp.asarray(np.stack(arrs))
 
-    x, y, ln = stack(stacks["x"]), stack(stacks["y"]), stack(stacks["ln"])
-    tx, ty, tln = stack(stacks["tx"]), stack(stacks["ty"]), stack(stacks["tln"])
-    mal = make_malicious_mask(config.num_clients, config.num_malicious_clients)
+    # Per-seed data partitions, stacked on a leading lane axis (shared and
+    # broadcast when every lane uses the same seed).
+    per_seed_data = len(set(seeds)) > 1
+
+    def load(seed):
+        ds = DatasetCatalog.get_dataset(
+            base.dataset, num_clients=base.num_clients, iid=base.iid,
+            alpha=base.dirichlet_alpha, seed=seed,
+        )
+        return ds
+
+    if per_seed_data:
+        stacks = {k: [] for k in ("x", "y", "ln", "tx", "ty", "tln")}
+        for s in seeds:
+            ds = load(s)
+            stacks["x"].append(ds.train.x)
+            stacks["y"].append(ds.train.y)
+            stacks["ln"].append(ds.train.lengths)
+            stacks["tx"].append(ds.test.x)
+            stacks["ty"].append(ds.test.y)
+            stacks["tln"].append(ds.test.lengths)
+
+        # Shard sizes can differ per seed under Dirichlet; pad to the widest.
+        def stack(arrs):
+            cap = max(a.shape[1] for a in arrs) if arrs[0].ndim > 1 else None
+            if cap is not None:
+                arrs = [
+                    np.pad(a, [(0, 0), (0, cap - a.shape[1])] + [(0, 0)] * (a.ndim - 2))
+                    for a in arrs
+                ]
+            return jnp.asarray(np.stack(arrs))
+
+        x, y, ln = stack(stacks["x"]), stack(stacks["y"]), stack(stacks["ln"])
+        tx, ty, tln = (stack(stacks["tx"]), stack(stacks["ty"]),
+                       stack(stacks["tln"]))
+        dax = 0
+    else:
+        ds = load(seeds[0])
+        x, y, ln = (jnp.asarray(ds.train.x), jnp.asarray(ds.train.y),
+                    jnp.asarray(ds.train.lengths))
+        tx, ty, tln = (jnp.asarray(ds.test.x), jnp.asarray(ds.test.y),
+                       jnp.asarray(ds.test.lengths))
+        dax = None
+    mal = make_malicious_mask(base.num_clients, base.num_malicious_clients)
 
     # Lane key streams, identical to the sequential driver's.
     keys = jax.vmap(lambda s: jax.random.PRNGKey(s))(jnp.asarray(seeds))
     init_keys, carry = jnp.moveaxis(jax.vmap(jax.random.split)(keys), 1, 0)
 
-    states = jax.vmap(fr.init, in_axes=(0, None))(init_keys, config.num_clients)
-    step = jax.jit(jax.vmap(fr.step, in_axes=(0, 0, 0, 0, None, 0)))
-    evaluate = jax.jit(jax.vmap(fr.evaluate, in_axes=(0, 0, 0, 0)))
+    states = jax.vmap(fr.init, in_axes=(0, None))(init_keys, base.num_clients)
 
-    interval = config.evaluation_interval
+    def lane_step(state, x, y, ln, mal, key, sc):
+        return _apply_lane(fr, sc).step(state, x, y, ln, mal, key)
+
+    def lane_eval(state, tx, ty, tln, sc):
+        return _apply_lane(fr, sc).evaluate(state, tx, ty, tln)
+
+    step = jax.jit(jax.vmap(
+        lane_step, in_axes=(0, dax, dax, dax, None, 0, 0)
+    ))
+    evaluate = jax.jit(jax.vmap(lane_eval, in_axes=(0, dax, dax, dax, 0)))
+
+    interval = base.evaluation_interval
     results: List[List[Dict]] = [[] for _ in range(L)]
     last_eval: List[Dict] = [{} for _ in range(L)]
     for r in range(1, max_rounds + 1):
         round_keys, carry = jnp.moveaxis(jax.vmap(jax.random.split)(carry), 1, 0)
-        states, metrics = step(states, x, y, ln, mal, round_keys)
+        states, metrics = step(states, x, y, ln, mal, round_keys, sc)
         if interval and r % interval == 0:
-            ev = evaluate(states, tx, ty, tln)
+            ev = evaluate(states, tx, ty, tln, sc)
             last_eval = [
                 {k: float(ev[k][i]) for k in ("test_loss", "test_acc",
                                               "test_acc_top3")}
@@ -99,6 +224,13 @@ def run_seed_lanes(config, seeds: List[int], max_rounds: int) -> List[List[Dict]
                 "update_norm_mean": float(metrics["update_norm_mean"][i]),
                 "seed": int(seeds[i]),
             }
+            row.update({k: v for k, v in lane_overrides[i].items()
+                        if k != "seed"})
             row.update(last_eval[i])
             results[i].append(row)
     return results
+
+
+def run_seed_lanes(config, seeds: List[int], max_rounds: int) -> List[List[Dict]]:
+    """Seed-only lanes (round-2 API): one trial per seed."""
+    return run_lanes(config.copy, [{"seed": int(s)} for s in seeds], max_rounds)

@@ -75,6 +75,80 @@ def load_experiments_from_file(path: str) -> Dict[str, Dict]:
 
 
 # ---------------------------------------------------------------------------
+# lane grouping: which trials can share one vmapped program?
+# ---------------------------------------------------------------------------
+
+# Trial-dict paths a lane may vary, mapped to tune.lanes flat keys.
+_LANE_PATHS = {
+    ("dataset_config", "seed"): "seed",
+    ("seed",): "seed",
+    ("client_config", "lr"): "client_lr",
+    ("client_lr",): "client_lr",
+    ("server_config", "lr"): "server_lr",
+    ("server_lr",): "server_lr",
+    ("dp_epsilon",): "dp_epsilon",
+    ("dp_clip_threshold",): "dp_clip_threshold",
+    ("adversary_config", "scale"): "adversary_scale",
+}
+_LANE_SENTINEL = "__LANE__"
+
+
+def _get_path(cfg, path):
+    node = cfg
+    for p in path:
+        if not isinstance(node, dict) or p not in node:
+            return None, False
+        node = node[p]
+    return node, True
+
+
+def _lane_signature(trial: Dict):
+    """(signature-json, {lane_key: value}) — trials with equal signatures
+    differ only in lane-traceable knobs."""
+    sig = copy.deepcopy(trial)
+    overrides = {}
+    for path, key in _LANE_PATHS.items():
+        val, present = _get_path(trial, path)
+        if present and not isinstance(val, (dict, list)):
+            overrides[key] = val
+            _set_path(sig, path, _LANE_SENTINEL)
+    return json.dumps(sig, sort_keys=True, default=str), overrides
+
+
+def lane_groups(trials: List[Dict]) -> List[List[int]]:
+    """Partition trial indices into groups runnable as one vmapped program
+    (same static config, differing only in lane knobs).  Singletons mean
+    'run sequentially'."""
+    by_sig: Dict[str, List[int]] = {}
+    for i, t in enumerate(trials):
+        sig, _ = _lane_signature(t)
+        by_sig.setdefault(sig, []).append(i)
+    return list(by_sig.values())
+
+
+def _lanes_eligible(spec_run: str, trial: Dict, group: List[int]) -> bool:
+    """Static gate: is this group safe to vmap? (Dense small-model trials
+    only — a vmapped giant-model federation would OOM where the
+    sequential driver streams.)"""
+    from blades_tpu.algorithms import get_algorithm_class
+
+    if len(group) < 2:
+        return False
+    try:
+        _, cfg = get_algorithm_class(spec_run, return_config=True)
+        cfg.update_from_dict(copy.deepcopy(trial))
+        cfg.validate()
+    except Exception:
+        return False
+    return (
+        cfg.execution in ("auto", "dense")
+        and cfg.num_clients <= 200
+        and not cfg.num_devices
+        and int(getattr(cfg, "rounds_per_dispatch", 1)) == 1
+    )
+
+
+# ---------------------------------------------------------------------------
 # trial runner (ref: train.py:310-408 without the Ray cluster)
 # ---------------------------------------------------------------------------
 
@@ -137,6 +211,77 @@ def _prune_checkpoints(
         shutil.rmtree(p, ignore_errors=True)
 
 
+def _run_lane_group(
+    spec_run: str,
+    trials: List[Dict],
+    group: List[int],
+    max_rounds: int,
+    exp_name: str,
+    root: Path,
+    verbose: int,
+) -> Dict[int, Dict]:
+    """Run one lane group as a vmapped program; write each member trial's
+    ``result.json``/``params.json`` exactly as the sequential path does and
+    return its summaries keyed by trial index."""
+    from blades_tpu.algorithms import get_algorithm_class
+    from blades_tpu.tune.lanes import run_lanes
+
+    sig_cfg = None
+    overrides = []
+    for i in group:
+        sig, ov = _lane_signature(trials[i])
+        overrides.append(ov)
+        sig_cfg = sig_cfg or json.loads(sig)
+
+    def strip_sentinels(node):
+        if isinstance(node, dict):
+            return {k: strip_sentinels(v) for k, v in node.items()
+                    if v != _LANE_SENTINEL}
+        if isinstance(node, list):
+            return [strip_sentinels(v) for v in node]
+        return node
+
+    shared = strip_sentinels(sig_cfg)
+
+    def builder():
+        _, cfg = get_algorithm_class(spec_run, return_config=True)
+        cfg.update_from_dict(copy.deepcopy(shared))
+        return cfg
+
+    if verbose:
+        print(f"== lane group {exp_name}[{group[0]}..{group[-1]}]: "
+              f"{len(group)} trials x {max_rounds} rounds as one program ==",
+              flush=True)
+    t0 = time.perf_counter()
+    results = run_lanes(builder, overrides, max_rounds)
+    wall = time.perf_counter() - t0
+
+    out: Dict[int, Dict] = {}
+    for lane, i in enumerate(group):
+        tname = _trial_name(exp_name, i, trials[i])
+        tdir = root / exp_name / tname
+        tdir.mkdir(parents=True, exist_ok=True)
+        with open(tdir / "params.json", "w") as f:
+            json.dump(_jsonable(trials[i]), f, indent=2, default=str)
+        rows = results[lane]
+        with open(tdir / "result.json", "w") as f:
+            for r in rows:
+                f.write(json.dumps(_jsonable({**r, "trial": tname})) + "\n")
+        best = max((r.get("test_acc", 0.0) for r in rows), default=0.0)
+        final = {k: rows[-1][k] for k in ("test_loss", "test_acc",
+                                          "test_acc_top3")
+                 if k in rows[-1]} if rows else {}
+        out[i] = {
+            "trial": tname, "rounds": max_rounds,
+            "wall_s": round(wall, 2),
+            "rounds_per_sec": round(max_rounds * len(group) / wall, 2)
+            if wall else None,
+            "best_test_acc": best, "final": final, "dir": str(tdir),
+            "lanes": len(group),
+        }
+    return out
+
+
 def run_experiments(
     experiments: Dict[str, Dict],
     storage_path: str = "~/blades_tpu_results",
@@ -148,8 +293,20 @@ def run_experiments(
     checkpoint_keep_num: Optional[int] = None,
     checkpoint_score_attr: str = "training_iteration",
     max_failures: int = 0,
+    lanes: bool = True,
 ) -> List[Dict]:
-    """Run every trial of every experiment sequentially; returns summaries.
+    """Run every trial of every experiment; returns summaries.
+
+    ``lanes=True`` (default): shape-compatible trial subsets — same static
+    config, differing only in lane-traceable knobs (seed, client/server
+    lr, DP epsilon/clip, IPM scale; see :mod:`blades_tpu.tune.lanes`) —
+    run as ONE vmapped program instead of sequentially, the TPU analogue
+    of the reference's concurrent Tune trials (ref:
+    blades/train.py:380-386).  Lanes engage only for fresh dense
+    small-model runs without checkpointing (checkpoint/resume/fault
+    machinery stays per-trial-sequential); everything else is
+    unaffected.  Results are written per trial exactly as in sequential
+    mode.
 
     Per trial: ``result.json`` (one JSON line per round, Tune's format) and
     ``params.json`` in ``<storage>/<experiment>/<trial>/``.
@@ -177,7 +334,32 @@ def run_experiments(
         trials = expand_grid(spec.get("config", {}))
         stop = spec.get("stop", {})
         max_rounds = int(max_rounds_override or stop.get("training_iteration", 100))
+
+        # Vmapped lane groups (concurrent-trial analogue).  Incompatible
+        # with checkpoint/resume/fault handling, which stay sequential.
+        laned: Dict[int, Dict] = {}
+        if (lanes and not resume and not checkpoint_freq
+                and not checkpoint_at_end and max_failures == 0):
+            for group in lane_groups(trials):
+                if not _lanes_eligible(spec["run"], trials[group[0]], group):
+                    continue
+                try:
+                    laned.update(_run_lane_group(
+                        spec["run"], trials, group, max_rounds, exp_name,
+                        root, verbose,
+                    ))
+                except Exception as exc:
+                    if verbose:
+                        print(f"   .. lane group {group} fell back to "
+                              f"sequential ({type(exc).__name__}: {exc})",
+                              flush=True)
+
         for i, trial_cfg in enumerate(trials):
+            if i in laned:
+                summaries.append(laned[i])
+                if verbose:
+                    print(f"   -> {laned[i]}", flush=True)
+                continue
             tname = _trial_name(exp_name, i, trial_cfg)
             tdir = root / exp_name / tname
             tdir.mkdir(parents=True, exist_ok=True)
