@@ -1,0 +1,70 @@
+"""Compile-probe the ResNet-18@576 streamed train block to find what
+pushed it over the HBM edge.
+
+Usage: python artifacts/perf_r4/probe_r18_oom.py [bn_vjp(0|1)] [out_dtype(bf16|f32)]
+"""
+
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import blades_tpu.models.layers as layers_mod
+
+bn_vjp = sys.argv[1] != "0" if len(sys.argv) > 1 else True
+od = jnp.bfloat16 if (len(sys.argv) < 3 or sys.argv[2] == "bf16") else None
+
+import os
+if not bn_vjp:
+    os.environ["BLADES_TPU_BN_VJP"] = "0"
+if False:
+    # Force the naive (pre-r4) BN formulation.
+    orig = layers_mod.BatchStatsNorm.__call__
+
+    import flax.linen as nn
+
+    def naive(self, x):
+        features = x.shape[-1]
+        scale = self.param("scale", nn.initializers.ones, (features,))
+        bias = self.param("bias", nn.initializers.zeros, (features,))
+        axes = tuple(range(x.ndim - 1))
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.var(x, axis=axes)
+        y = (x - mean) * jax.lax.rsqrt(var + self.epsilon)
+        return y * scale + bias
+
+    layers_mod.BatchStatsNorm.__call__ = nn.compact(naive)
+
+from blades_tpu.adversaries import get_adversary, make_malicious_mask
+from blades_tpu.core import FedRound, Server, TaskSpec
+from blades_tpu.parallel.streamed import streamed_step
+
+N, CB, BATCH = 576, 32, 32
+task = TaskSpec(model="resnet18", input_shape=(32, 32, 3), num_classes=10,
+                lr=0.1, compute_dtype="bfloat16").build()
+server = Server.from_config(aggregator="Median", lr=0.5)
+adv = get_adversary("ALIE", num_clients=N, num_byzantine=N // 4)
+fr = FedRound(task=task, server=server, adversary=adv, batch_size=BATCH,
+              num_batches_per_round=1)
+state = fr.init(jax.random.PRNGKey(0), N)
+step = streamed_step(fr, client_block=CB, d_chunk=1 << 17)
+d = sum(p.size for p in jax.tree.leaves(state.server.params))
+from blades_tpu.ops.pallas_select import _BLOCK_D
+
+d_alloc = -(-d // _BLOCK_D) * _BLOCK_D
+buf = jnp.zeros((N, d_alloc), jnp.bfloat16)
+x = jnp.zeros((N, 32, 32, 32, 3), jnp.float32)
+y = jnp.zeros((N, 32), jnp.int32)
+lengths = jnp.full((N,), 32, jnp.int32)
+mal = make_malicious_mask(N, N // 4)
+keys = jax.random.split(jax.random.PRNGKey(0), N)
+try:
+    lowered = step.train_block.lower(
+        buf, state.client_opt, state.server.params, x, y, lengths, mal,
+        keys, keys, jnp.int32(0))
+    c = lowered.compile()
+    mem = c.memory_analysis()
+    print("OK  bn_vjp=%s out=%s: %s" % (bn_vjp, od, mem))
+except Exception as e:
+    print("OOM bn_vjp=%s out=%s: %s" % (bn_vjp, od, str(e)[:300]))

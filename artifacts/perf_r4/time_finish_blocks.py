@@ -1,0 +1,80 @@
+"""Round-4: fused finish vs _BLOCK_D (grid-step overhead hypothesis).
+
+Run: cd /root/repo && PYTHONPATH="$PYTHONPATH:." python artifacts/perf_r4/time_finish_blocks.py
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+import blades_tpu.ops.pallas_round as pr
+
+N = 1000
+D = 4_903_242
+PASSES = 6
+REP = 6
+
+
+def build(block_d: int, kw: dict, updates, mal):
+    def f(u, m):
+        # __wrapped__: bypass fused_finish's jit cache (1024 and 2048
+        # pad to the SAME d_alloc, so the cached trace would collide).
+        ff = pr.fused_finish.__wrapped__
+
+        def body(c, _):
+            m2 = m ^ (c != c)
+            a, sq, bad = ff(u, m2, None, **kw)
+            return a[0] + sq[0], None
+
+        out, _ = jax.lax.scan(body, jnp.float32(0.0), None, length=REP)
+        return out
+
+    return jax.jit(f)
+
+
+def main():
+    # ONE shared buffer (HBM fits only one): width divisible by every
+    # tested block size, so no in-call padding for any variant.
+    d_alloc = 4904960
+    assert all(d_alloc % b == 0 for b in (512, 1024, 2048))
+    updates = jnp.zeros((N, d_alloc), jnp.bfloat16)
+    mal = jnp.arange(N) < N // 4
+
+    cfgs = {
+        "mean_nosan": dict(forge=None, agg=("mean",), sanitize=False),
+        "median_alie_san": dict(forge=("alie", 1.5), agg=("median",),
+                                sanitize=True),
+    }
+    runs = {}
+    for block_d in (512, 1024, 2048):
+        pr._BLOCK_D = block_d
+        for cname, kw in cfgs.items():
+            name = f"{cname}_b{block_d}"
+            try:
+                jf = build(block_d, kw, updates, mal)
+                t0 = time.perf_counter()
+                v = float(jf(updates, mal))
+                print(f"# compile {name}: {time.perf_counter() - t0:.1f}s",
+                      flush=True)
+                runs[name] = jf
+            except Exception as e:
+                print(f"# {name} FAILED: {type(e).__name__}: {str(e)[:200]}",
+                      flush=True)
+
+    times = {v: [] for v in runs}
+    for p in range(PASSES):
+        for name, jf in runs.items():
+            t0 = time.perf_counter()
+            _ = float(jf(updates, mal))
+            times[name].append((time.perf_counter() - t0) / REP)
+
+    print(json.dumps({v: {"ms_min": round(min(ts) * 1e3, 1)}
+                      for v, ts in times.items()}, indent=2))
+
+
+if __name__ == "__main__":
+    main()

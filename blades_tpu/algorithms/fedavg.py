@@ -132,6 +132,10 @@ class Fedavg:
                 client_block=self._streamed_block(),
                 d_chunk=cfg.d_chunk,
                 update_dtype=getattr(jnp, str(cfg.update_dtype)),
+                # self.malicious IS the canonical prefix mask (built via
+                # make_malicious_mask above) — lets forged-update rounds
+                # skip the dead malicious-lane training blocks.
+                malicious_prefix=cfg.num_malicious_clients,
             )
             self._evaluate = jax.jit(self.fed_round.evaluate)
         else:

@@ -174,6 +174,7 @@ class Task:
         grad_hook: GradHook = identity_grad_hook,
         round_begin_hook=identity_round_begin_hook,
         round_end_hook=identity_round_end_hook,
+        out_dtype=None,
     ):
         """One client's full local round: scan SGD over ``num_batches``.
 
@@ -189,6 +190,12 @@ class Task:
                 callbacks.py:25-31, :50-56); ``round_end`` edits the flat
                 pseudo-gradient the way the reference's
                 ``on_train_round_end`` edits ``pseudo_grad_vec``.
+            out_dtype: storage dtype of the returned update vector (the
+                streamed round's bf16 matrix).  With the identity
+                round_end_hook the cast happens per LEAF before the
+                concat — bit-identical values (cast commutes with
+                concatenation), but the flat-vector assembly passes run
+                at storage width instead of f32.
 
         Returns:
             ``(update_vec, new_opt_state, mean_loss)`` where ``update_vec`` is
@@ -212,8 +219,16 @@ class Task:
         )
         # Pseudo-grad is always vs the INCOMING global params (the
         # reference snapshots the global weights, ref: task.py:159-168).
-        update = ravel(params) - ravel(global_params)
-        update = round_end_hook(update, malicious)
+        if out_dtype is not None and round_end_hook is identity_round_end_hook:
+            update = ravel(jax.tree.map(
+                lambda p1, p0: (p1 - p0).astype(out_dtype),
+                params, global_params,
+            ))
+        else:
+            update = ravel(params) - ravel(global_params)
+            update = round_end_hook(update, malicious)
+            if out_dtype is not None:
+                update = update.astype(out_dtype)
         return update, opt_state, losses.mean()
 
     def local_round_batched(
@@ -228,6 +243,7 @@ class Task:
         grad_hook: GradHook = identity_grad_hook,
         round_begin_hook=identity_round_begin_hook,
         round_end_hook=identity_round_end_hook,
+        out_dtype=None,
     ):
         """A whole client block's local rounds: ``(G, nb, B, ...)`` batches
         -> ``(updates (G, d), new_opt_states, losses (G,))``.
@@ -244,15 +260,19 @@ class Task:
         from blades_tpu.core.fedsgd import fedsgd_round, supports_fedsgd
 
         if supports_fedsgd(self, batches_x.shape[1], round_begin_hook):
-            return fedsgd_round(
+            upd, opt2, losses = fedsgd_round(
                 self, global_params, opt_states, batches_x, batches_y,
                 client_keys, malicious, data_hook, grad_hook, round_end_hook,
             )
+            if out_dtype is not None:
+                upd = upd.astype(out_dtype)
+            return upd, opt2, losses
 
         def one_client(opt_state, cbx, cby, ck, mal):
             return self.local_round(
                 global_params, opt_state, cbx, cby, ck, mal,
                 data_hook, grad_hook, round_begin_hook, round_end_hook,
+                out_dtype=out_dtype,
             )
 
         return jax.vmap(one_client)(

@@ -152,6 +152,74 @@ def _sg(x):
     return lax.stop_gradient(x)
 
 
+# --------------------------------------------------------------------------
+# Hand-written batch-stats-norm VJP (ungrouped path)
+# --------------------------------------------------------------------------
+#
+# Autodiff of the naive mean/var formulation leaves XLA with five
+# separate reductions per BN layer in the backward; writing the standard
+# BN backward by hand (two reductions, dscale reused for the dx projection)
+# measured ~4% off the whole vmapped ResNet-10 training block on a v5e
+# (artifacts/perf_r4/time_bn.py).  Stats accumulate in f32 with a
+# two-pass centered variance (robust for any |mean|/std the activations
+# reach); the backward is where the win lives.
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _bn_apply(x, scale, bias, eps):
+    y, _ = _bn_apply_fwd(x, scale, bias, eps)
+    return y
+
+
+def _bn_apply_fwd(x, scale, bias, eps):
+    axes = tuple(range(x.ndim - 1))
+    n = 1
+    for a in axes:
+        n *= x.shape[a]
+    xhat, mean, r = _bn_normalize(x, axes, eps)
+    y = xhat * scale + bias
+    # Residuals: x is the producing conv's output, which XLA materializes
+    # anyway — saving xhat instead would add one full activation set per
+    # BN layer and compile-OOMs the 1000-client bench block.
+    return y, (x, mean, r, scale, n)
+
+
+def _bn_apply_bwd(eps, res, dy):
+    x, mean, r, scale, n = res
+    axes = tuple(range(dy.ndim - 1))
+    xhat = (x - mean) * r
+    dbias = jnp.sum(dy.astype(jnp.float32), axis=axes).astype(dy.dtype)
+    dscale = jnp.sum((dy * xhat).astype(jnp.float32), axis=axes).astype(
+        dy.dtype)
+    dxhat = dy * scale
+    mean_dxhat = jnp.sum(dxhat.astype(jnp.float32), axis=axes).astype(
+        dy.dtype) / n
+    mean_dxhat_xhat = dscale * scale / n
+    dx = r * (dxhat - mean_dxhat - xhat * mean_dxhat_xhat)
+    return dx, dscale, dbias
+
+
+_bn_apply.defvjp(_bn_apply_fwd, _bn_apply_bwd)
+
+
+def _bn_normalize(x, axes, eps, keepdims=False):
+    """f32 stats + normalize shared by every branch that must numerically
+    match :func:`_bn_apply` (the grouped path uses it under plain
+    autodiff).  Two-pass CENTERED variance: the one-pass E[x^2] - mean^2
+    form loses the variance entirely to f32 rounding when
+    |mean|/std > ~2^12, which f32 activations can hit.
+
+    Returns ``(xhat, mean, r)`` with mean/r cast to ``x.dtype``.
+    """
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=keepdims)
+    var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=keepdims)
+    r = lax.rsqrt(var + eps)
+    mean = mean.astype(x.dtype)
+    r = r.astype(x.dtype)
+    return (x - mean) * r, mean, r
+
+
 def _grouped_affine(vec, phantom, groups, ndim):
     """Per-client channel vector ``stop_grad(vec) + phantom`` broadcast to a
     ``(G, ...)``-grouped activation of rank ``ndim`` (including the group
@@ -314,12 +382,27 @@ class BatchStatsNorm(nn.Module):
             if self.use_bias
             else None
         )
+        import os
+
+        # Escape hatch to the pre-r4 two-pass jnp.mean/jnp.var stats.
+        # Read at TRACE time: flipping it after a jitted program compiled
+        # has no effect on that program — set it before the first forward
+        # (fresh process), like BLADES_TPU_NO_PALLAS.  Governs BOTH the
+        # ungrouped and the grouped branch, so the FedSGD equivalence
+        # (grouped vs vmapped stats bit-matching) holds in either mode.
+        hand_vjp = os.environ.get("BLADES_TPU_BN_VJP", "1") != "0"
         groups = current_groups()
         if groups is None:
+            if scale is not None and bias is not None and hand_vjp:
+                return _bn_apply(x, scale.astype(x.dtype),
+                                 bias.astype(x.dtype), self.epsilon)
             axes = tuple(range(x.ndim - 1))
-            mean = jnp.mean(x, axis=axes)
-            var = jnp.var(x, axis=axes)
-            y = (x - mean) * jax.lax.rsqrt(var + self.epsilon)
+            if hand_vjp:  # use_scale/use_bias off: stats formula still
+                y = _bn_normalize(x, axes, self.epsilon)[0]  # matches _bn_apply
+            else:
+                mean = jnp.mean(x, axis=axes)
+                var = jnp.var(x, axis=axes)
+                y = (x - mean) * jax.lax.rsqrt(var + self.epsilon)
             if scale is not None:
                 y = y * scale
             if bias is not None:
@@ -329,9 +412,15 @@ class BatchStatsNorm(nn.Module):
         b = x.shape[0] // g
         xr = x.reshape((g, b) + x.shape[1:])
         axes = tuple(range(1, xr.ndim - 1))
-        mean = jnp.mean(xr, axis=axes, keepdims=True)
-        var = jnp.var(xr, axis=axes, keepdims=True)
-        yr = (xr - mean) * jax.lax.rsqrt(var + self.epsilon)
+        if hand_vjp:
+            # Same f32 stats formula as _bn_apply_fwd — the FedSGD
+            # equivalence tests compare this path against the vmapped one
+            # at tight tolerance, so the stat numerics must match exactly.
+            yr = _bn_normalize(xr, axes, self.epsilon, keepdims=True)[0]
+        else:
+            mean = jnp.mean(xr, axis=axes, keepdims=True)
+            var = jnp.var(xr, axis=axes, keepdims=True)
+            yr = (xr - mean) * jax.lax.rsqrt(var + self.epsilon)
         # Per-client affine via broadcast phantom params — plain autodiff,
         # so dscale_c / dbias_c are ordinary fused channel reductions.
         if scale is not None:

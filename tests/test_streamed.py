@@ -148,3 +148,71 @@ def test_streamed_dp_noise_is_applied(data):
     # coords the measured mean row norm must sit far above the clip.
     assert float(m["update_norm_mean"]) > 0.05 * 2
     assert np.isfinite(float(m["train_loss"]))
+
+
+@pytest.mark.parametrize("aggregator,adversary", [
+    ("Median", "ALIE"),          # fused-eligible coordinate path (chunked on CPU)
+    ("GeoMed", "IPM"),           # row-geometry aggregator, coordinate forge
+    ("Median", "MinMax"),        # row-geometry forge, coordinate aggregator
+])
+def test_malicious_prefix_elision_is_exact(data, aggregator, adversary):
+    """Skipping the dead malicious-lane training blocks must reproduce the
+    full round bit-for-bit at f32 storage: same server params, same
+    aggregate/metrics, same benign-lane outputs (the forged rows never
+    depended on what malicious clients trained)."""
+    x, y, ln, mal = data
+    fr = make_fr(aggregator, adversary)
+    key = jax.random.PRNGKey(7)
+
+    st_a = fr.init(jax.random.PRNGKey(0), N)
+    full = streamed_step(fr, client_block=2, d_chunk=10_000,
+                         update_dtype=jnp.float32)
+    st_a, m_a = full(st_a, x, y, ln, mal, key)
+
+    st_b = fr.init(jax.random.PRNGKey(0), N)
+    elided = streamed_step(fr, client_block=2, d_chunk=10_000,
+                           update_dtype=jnp.float32, malicious_prefix=F)
+    st_b, m_b = elided(st_b, x, y, ln, mal, key)
+
+    for a, b in zip(jax.tree.leaves(st_a.server.params),
+                    jax.tree.leaves(st_b.server.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for k in ("train_loss", "agg_norm", "update_norm_mean"):
+        np.testing.assert_array_equal(np.asarray(m_a[k]), np.asarray(m_b[k]))
+
+
+def test_malicious_prefix_without_forge_trains_everyone(data):
+    """No update forge (training-only attack): malicious training is NOT
+    dead, and the prefix hint must be ignored."""
+    x, y, ln, mal = data
+    fr = make_fr("Mean", "SignFlip")
+    key = jax.random.PRNGKey(7)
+
+    st_a = fr.init(jax.random.PRNGKey(0), N)
+    full = streamed_step(fr, client_block=2, d_chunk=10_000,
+                         update_dtype=jnp.float32)
+    st_a, m_a = full(st_a, x, y, ln, mal, key)
+
+    st_b = fr.init(jax.random.PRNGKey(0), N)
+    hinted = streamed_step(fr, client_block=2, d_chunk=10_000,
+                           update_dtype=jnp.float32, malicious_prefix=F)
+    st_b, m_b = hinted(st_b, x, y, ln, mal, key)
+
+    for a, b in zip(jax.tree.leaves(st_a.server.params),
+                    jax.tree.leaves(st_b.server.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_array_equal(np.asarray(m_a["train_loss"]),
+                                  np.asarray(m_b["train_loss"]))
+
+
+def test_malicious_prefix_promise_is_validated(data):
+    """A mask that disagrees with the promised prefix must fail loudly,
+    not silently aggregate zero rows for benign clients."""
+    x, y, ln, _ = data
+    bad_mask = jnp.arange(N) >= (N - F)  # malicious at the TAIL
+    fr = make_fr("Median", "ALIE")
+    st = fr.init(jax.random.PRNGKey(0), N)
+    step = streamed_step(fr, client_block=2, d_chunk=10_000,
+                         update_dtype=jnp.float32, malicious_prefix=F)
+    with pytest.raises(ValueError, match="elision"):
+        step(st, x, y, ln, bad_mask, jax.random.PRNGKey(7))
