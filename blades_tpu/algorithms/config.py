@@ -162,10 +162,11 @@ class FedavgConfig:
                          evaluation_num_samples=num_samples)
 
     def resources(self, *, num_devices=None, execution=None, client_block=None,
-                  d_chunk=None, update_dtype=None):
+                  d_chunk=None, update_dtype=None, compute_dtype=None):
         return self._set(num_devices=num_devices, execution=execution,
                          client_block=client_block, d_chunk=d_chunk,
-                         update_dtype=update_dtype)
+                         update_dtype=update_dtype,
+                         compute_dtype=compute_dtype)
 
     def fault_tolerance(self, *, health_check=None):
         """In-round failure detection / elastic recovery (core/health.py);
@@ -316,7 +317,15 @@ class FedavgConfig:
     def get_task_spec(self) -> TaskSpec:
         augment = self.augment
         if augment == "auto":
-            name = self.dataset if isinstance(self.dataset, str) else ""
+            # Resolve the dataset NAME the same way validate() does — a
+            # catalog dict spec (e.g. {"type": "cifar10",
+            # "synthetic_noise": ...}) must still enable cifar crop+flip.
+            if isinstance(self.dataset, str):
+                name = self.dataset
+            elif isinstance(self.dataset, dict):
+                name = self.dataset.get("type") or ""
+            else:
+                name = getattr(self.dataset, "name", "") or ""
             augment = "cifar" if str(name).lower() in ("cifar10", "cifar100") else None
         return TaskSpec(
             model=self.global_model, num_classes=self.num_classes,

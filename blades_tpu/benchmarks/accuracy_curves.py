@@ -31,7 +31,8 @@ MODELS = {"mnist": "mlp", "fashionmnist": "cnn", "cifar10": "resnet10",
 
 def run_cell(dataset, model, aggregator, num_malicious, adversary, rounds,
              seed, num_clients, chunk, iid=True, alpha=0.1,
-             synthetic_noise=0.5):
+             synthetic_noise=0.5, client_lr=0.1, server_lr=1.0,
+             batch_size=None, compute_dtype=None):
     from blades_tpu.algorithms import FedavgConfig
 
     spec = dataset
@@ -39,12 +40,22 @@ def run_cell(dataset, model, aggregator, num_malicious, adversary, rounds,
         # Difficulty dial for the synthetic fallback (real raw data
         # ignores it): see datasets._synthetic_classification.
         spec = {"type": dataset, "synthetic_noise": synthetic_noise}
+    agg_spec = {"type": aggregator}
+    if aggregator == "Multikrum":
+        # Multi-Krum's m (selection-set size): average the n - f
+        # best-scoring updates.  The reference class defaults k=1 (pure
+        # Krum), but under non-IID partitions one client's update per
+        # round destroys even the BENIGN baseline (measured 19% at zero
+        # attackers, VERDICT r3) — n - f is the paper's multi-krum
+        # operating point and what the f-aware defenses here get too.
+        agg_spec["k"] = max(num_clients - num_malicious, 1)
     cfg = (
         FedavgConfig()
         .data(dataset=spec, num_clients=num_clients, iid=iid,
               dirichlet_alpha=alpha, seed=seed)
-        .training(global_model=model,
-                  aggregator={"type": aggregator}, server_lr=1.0)
+        .training(global_model=model, aggregator=agg_spec,
+                  server_lr=server_lr, train_batch_size=batch_size)
+        .client(lr=client_lr)
         .adversary(
             num_malicious_clients=num_malicious,
             adversary_config=(
@@ -55,6 +66,8 @@ def run_cell(dataset, model, aggregator, num_malicious, adversary, rounds,
         .evaluation(evaluation_interval=max(rounds // 4, 1))
     )
     cfg.rounds_per_dispatch = chunk
+    if compute_dtype:
+        cfg = cfg.resources(compute_dtype=compute_dtype)
     algo = cfg.build()
     best = 0.0
     while algo.iteration < rounds:
@@ -94,6 +107,15 @@ def main(argv=None) -> int:
                    help="difficulty of the synthetic fallback (no effect "
                    "on real data); ~3.0 makes attack/defense orderings "
                    "visible on cifar10/resnet10, ~8.0 on mnist/mlp")
+    p.add_argument("--client-lr", type=float, default=0.1)
+    p.add_argument("--server-lr", type=float, default=1.0,
+                   help="the reference figure runs client 1.0 / server "
+                   "0.1 (fedavg_cifar10_resnet_noniid.yaml)")
+    p.add_argument("--batch-size", type=int, default=None,
+                   help="per-client train batch (reference figure: 64)")
+    p.add_argument("--compute-dtype", default=None,
+                   help="e.g. bfloat16 — needed for batch 64 on a 16 GB "
+                   "chip (f32 activations OOM)")
     args = p.parse_args(argv)
 
     model = args.model or MODELS.get(args.dataset, "mlp")
@@ -113,6 +135,10 @@ def main(argv=None) -> int:
             "num_clients": args.num_clients,
             "noniid_alpha": args.noniid_alpha,
             "synthetic_noise": args.synthetic_noise,
+            "client_lr": args.client_lr,
+            "server_lr": args.server_lr,
+            "batch_size": args.batch_size,
+            "compute_dtype": args.compute_dtype,
             "complete": len(rows) == len(args.aggregators) * len(args.malicious),
             "rows": rows,
         }
@@ -127,7 +153,11 @@ def main(argv=None) -> int:
                            args.rounds_per_dispatch,
                            iid=args.noniid_alpha is None,
                            alpha=args.noniid_alpha or 0.1,
-                           synthetic_noise=args.synthetic_noise)
+                           synthetic_noise=args.synthetic_noise,
+                           client_lr=args.client_lr,
+                           server_lr=args.server_lr,
+                           batch_size=args.batch_size,
+                           compute_dtype=args.compute_dtype)
             row["wall_s"] = round(time.perf_counter() - t0, 1)
             rows.append(row)
             print(json.dumps(row), flush=True)

@@ -107,6 +107,96 @@ def should_use(n: int, d: int) -> bool:
     return kernel_applicable(n, d) and n % 8 == 0
 
 
+def _kth_key16_mult(keys, k, fkey, mult: int):
+    """:func:`_kth_key16` over the multiset ``keys + mult x fkey`` —
+    ``fkey`` is a (1, c) virtual key counted ``mult`` times per column.
+    ``k`` may be a static int or a (1, c) per-column rank vector."""
+    c = keys.shape[1]
+    res = jnp.zeros((1, c), jnp.uint32)
+    for bit in range(15, -1, -1):
+        cand = res | jnp.uint32(1 << bit)
+        cnt = jnp.sum((keys < cand).astype(jnp.int32), axis=0, keepdims=True)
+        cnt = cnt + mult * (fkey < cand).astype(jnp.int32)
+        res = jnp.where(cnt <= k, cand, res)
+    return res
+
+
+def _next_key16_above_mult(keys, v, fkey):
+    """Smallest key strictly greater than ``v`` over keys + the virtual
+    forged key."""
+    nxt = _next_key16_above(keys, v)
+    fnext = jnp.where(fkey > v, fkey, jnp.uint32(0x10000))
+    return jnp.minimum(nxt, fnext)
+
+
+def _kth_key_mult(keys, k, fkey, mult: int):
+    """32-step :func:`_kth_key16_mult` for full uint32 keys (f32 data)."""
+    c = keys.shape[1]
+    res = jnp.zeros((1, c), jnp.uint32)
+    for bit in range(31, -1, -1):
+        cand = res | jnp.uint32(1 << bit)
+        cnt = jnp.sum((keys < cand).astype(jnp.int32), axis=0, keepdims=True)
+        cnt = cnt + mult * (fkey < cand).astype(jnp.int32)
+        res = jnp.where(cnt <= k, cand, res)
+    return res
+
+
+def _next_key_above_mult(keys, v, fkey):
+    nxt = _next_key_above(keys, v)
+    # 0xFFFFFFFF (the +inf/NaN key) is its own successor ceiling; the
+    # unsigned compare is safe in uint32 space here because fkey is a
+    # finite value's key.
+    fnext = jnp.where(fkey > v, fkey, jnp.uint32(0xFFFFFFFF))
+    return jnp.minimum(nxt, fnext)
+
+
+def _forged_stripe(xs, wb, r_ref, forge, keys16: bool):
+    """The (1, c) forged row for this stripe from benign statistics —
+    shared between the full kernel (which scatters it into malicious
+    rows) and the compact kernel (which counts it with multiplicity).
+    ``xs``: (rows, c) f32 with non-benign rows zeroed; ``wb``: (rows, 1)
+    benign weights."""
+    kind = forge[0]
+    nb = jnp.maximum(jnp.sum(wb), 1.0)
+    mean = jnp.sum(xs * wb, axis=0, keepdims=True) / nb
+    if kind == "alie":
+        z = forge[1]
+        var = jnp.sum((xs - mean) ** 2 * wb, axis=0, keepdims=True)
+        std = jnp.sqrt(var / jnp.maximum(nb - 1.0, 1.0))
+        forged = mean + z * std
+    elif kind == "ipm":
+        forged = -forge[1] * mean
+    elif kind == "adaptive":
+        # Fang directed deviation (the four sign-cases of
+        # AdaptiveAdversary.on_updates_ready); r_ref carries the
+        # pre-drawn per-coordinate uniforms.
+        b = forge[1]
+        r = r_ref[...]
+        mx = jnp.max(jnp.where(wb > 0, xs, -jnp.inf), axis=0, keepdims=True)
+        mn = jnp.min(jnp.where(wb > 0, xs, jnp.inf), axis=0, keepdims=True)
+        s = jnp.sign(mean)
+        neg_pos = r * ((b - 1.0) * mx) + mx
+        neg_neg = r * ((1.0 / b - 1.0) * mx) + mx
+        pos_pos = r * ((1.0 - 1.0 / b) * mn) + mn / b
+        pos_neg = r * ((1.0 - b) * mn) + mn * b
+        forged = jnp.where(
+            s == -1.0,
+            jnp.where(mx > 0, neg_pos, neg_neg),
+            jnp.where(s == 1.0,
+                      jnp.where(mn > 0, pos_pos, pos_neg),
+                      mean),
+        )
+    else:  # pragma: no cover - guarded by the callers
+        raise ValueError(f"unknown forge {kind!r}")
+    if keys16:
+        # bf16 storage: round the forged row to storage precision so
+        # every matrix value is bf16-representable — the semantics of an
+        # adversary writing into the same bf16 buffer, and what lets the
+        # rank search run 16 steps instead of 32.
+        forged = forged.astype(jnp.bfloat16).astype(jnp.float32)
+    return forged
+
+
 def _fused_kernel(x_ref, wb_ref, fm_ref, r_ref, o_ref, sq_ref, bad_ref, *,
                   n_true: int, forge: Optional[tuple], agg: tuple,
                   sanitize: bool, keys16: bool):
@@ -132,46 +222,7 @@ def _fused_kernel(x_ref, wb_ref, fm_ref, r_ref, o_ref, sq_ref, bad_ref, *,
     xs = jnp.where(real > 0, x, 0.0)
 
     if forge is not None:
-        kind = forge[0]
-        nb = jnp.maximum(jnp.sum(wb), 1.0)
-        mean = jnp.sum(xs * wb, axis=0, keepdims=True) / nb
-        if kind == "alie":
-            z = forge[1]
-            var = jnp.sum((xs - mean) ** 2 * wb, axis=0, keepdims=True)
-            std = jnp.sqrt(var / jnp.maximum(nb - 1.0, 1.0))
-            forged = mean + z * std
-        elif kind == "ipm":
-            forged = -forge[1] * mean
-        elif kind == "adaptive":
-            # Fang directed deviation (the four sign-cases of
-            # AdaptiveAdversary.on_updates_ready); r_ref carries the
-            # pre-drawn per-coordinate uniforms.
-            b = forge[1]
-            r = r_ref[...]
-            mx = jnp.max(jnp.where(wb > 0, xs, -jnp.inf), axis=0,
-                         keepdims=True)
-            mn = jnp.min(jnp.where(wb > 0, xs, jnp.inf), axis=0,
-                         keepdims=True)
-            s = jnp.sign(mean)
-            neg_pos = r * ((b - 1.0) * mx) + mx
-            neg_neg = r * ((1.0 / b - 1.0) * mx) + mx
-            pos_pos = r * ((1.0 - 1.0 / b) * mn) + mn / b
-            pos_neg = r * ((1.0 - b) * mn) + mn * b
-            forged = jnp.where(
-                s == -1.0,
-                jnp.where(mx > 0, neg_pos, neg_neg),
-                jnp.where(s == 1.0,
-                          jnp.where(mn > 0, pos_pos, pos_neg),
-                          mean),
-            )
-        else:  # pragma: no cover - guarded by fused_finish
-            raise ValueError(f"unknown forge {kind!r}")
-        if keys16:
-            # bf16 storage: round the forged row to storage precision so
-            # every matrix value is bf16-representable — the semantics of
-            # an adversary writing into the same bf16 buffer, and what
-            # lets the rank search below run 16 steps instead of 32.
-            forged = forged.astype(jnp.bfloat16).astype(jnp.float32)
+        forged = _forged_stripe(xs, wb, r_ref, forge, keys16)
         xs = jnp.where(fm > 0, forged, xs)
 
     sq_ref[...] += jnp.sum(xs * xs, axis=1, keepdims=True)
@@ -230,6 +281,197 @@ def _fused_kernel(x_ref, wb_ref, fm_ref, r_ref, o_ref, sq_ref, bad_ref, *,
         o_ref[...] = total / kept
     else:  # pragma: no cover - guarded by fused_finish
         raise ValueError(f"unknown aggregator {akind!r}")
+
+
+def _compact_kernel(x_ref, wb_ref, r_ref, o_ref, sq_ref, bad_ref, fr_ref, *,
+                    nb_true: int, mult: int, forge: tuple, agg: tuple,
+                    sanitize: bool, keys16: bool):
+    """The benign-compacted finish: the matrix holds ONLY benign rows
+    (malicious training was elided), and the forged row participates in
+    the order statistics as a VIRTUAL row of multiplicity ``mult`` —
+    every per-row pass (load, keys, radix counts) runs over ``nb`` rows
+    instead of ``nb + mult``."""
+    i = pl.program_id(0)
+    x = x_ref[...].astype(jnp.float32)          # (nbpad, c) benign stripe
+    wb = wb_ref[...]                            # (nbpad, 1) real-row mask
+
+    @pl.when(i == 0)
+    def _init():
+        sq_ref[...] = jnp.zeros_like(sq_ref)
+        bad_ref[...] = jnp.zeros_like(bad_ref)
+
+    if sanitize:
+        row_ok = jnp.isfinite(x).all(axis=1, keepdims=True)
+        row_bad = wb * (1.0 - row_ok.astype(jnp.float32))
+        x = jnp.where(row_bad > 0, 0.0, x)
+        bad_ref[...] = jnp.maximum(bad_ref[...], row_bad)
+
+    xs = jnp.where(wb > 0, x, 0.0)
+    forged = _forged_stripe(xs, wb, r_ref, forge, keys16)
+    fr_ref[...] = forged
+    sq_ref[...] += jnp.sum(xs * xs, axis=1, keepdims=True)
+
+    if keys16:
+        kth, nxt, vals, keys_of = (
+            _kth_key16_mult, _next_key16_above_mult, _vals16_of, _keys16_of
+        )
+    else:
+        kth, nxt, vals, keys_of = (
+            _kth_key_mult, _next_key_above_mult, _vals_of, _keys_of
+        )
+
+    n_tot = nb_true + mult
+    akind = agg[0]
+    if akind == "mean":
+        o_ref[...] = (jnp.sum(xs, axis=0, keepdims=True)
+                      + mult * forged) / n_tot
+        return
+    keys = keys_of(jnp.where(wb > 0, xs, jnp.inf))
+    fkey = keys_of(forged)
+    if akind == "median":
+        k1, k2 = (n_tot - 1) // 2, n_tot // 2
+        v1 = kth(keys, k1, fkey, mult)
+        if k2 == k1:
+            o_ref[...] = vals(v1)
+        else:
+            cnt_le = (jnp.sum((keys <= v1).astype(jnp.int32), axis=0,
+                              keepdims=True)
+                      + mult * (fkey <= v1).astype(jnp.int32))
+            v2 = jnp.where(cnt_le >= k2 + 1, v1, nxt(keys, v1, fkey))
+            o_ref[...] = (vals(v1) + vals(v2)) * 0.5
+    elif akind == "trimmed":
+        k_cut = agg[1]
+        xm = jnp.where(wb > 0, xs, jnp.inf)
+        vlo = kth(keys, k_cut, fkey, mult)
+        vhi = kth(keys, n_tot - 1 - k_cut, fkey, mult)
+        flo, fhi = vals(vlo), vals(vhi)
+        between = (keys > vlo) & (keys < vhi)
+        f_between = ((fkey > vlo) & (fkey < vhi)).astype(jnp.float32)
+        sum_mid = (jnp.sum(jnp.where(between, xm, 0.0), axis=0,
+                           keepdims=True)
+                   + mult * forged * f_between)
+        cnt_lt_lo = (jnp.sum((keys < vlo).astype(jnp.int32), axis=0,
+                             keepdims=True)
+                     + mult * (fkey < vlo).astype(jnp.int32))
+        eq_lo = (jnp.sum((keys == vlo).astype(jnp.int32), axis=0,
+                         keepdims=True)
+                 + mult * (fkey == vlo).astype(jnp.int32))
+        cnt_lt_hi = (jnp.sum((keys < vhi).astype(jnp.int32), axis=0,
+                             keepdims=True)
+                     + mult * (fkey < vhi).astype(jnp.int32))
+        eq_hi = (jnp.sum((keys == vhi).astype(jnp.int32), axis=0,
+                         keepdims=True)
+                 + mult * (fkey == vhi).astype(jnp.int32))
+        lo_keep = jnp.clip(
+            jnp.minimum(cnt_lt_lo + eq_lo, n_tot - k_cut)
+            - jnp.maximum(cnt_lt_lo, k_cut), 0, None)
+        hi_keep = jnp.clip(
+            jnp.minimum(cnt_lt_hi + eq_hi, n_tot - k_cut)
+            - jnp.maximum(cnt_lt_hi, k_cut), 0, None)
+        kept = n_tot - 2 * k_cut
+        total = sum_mid + lo_keep.astype(jnp.float32) * flo \
+            + hi_keep.astype(jnp.float32) * fhi
+        total = jnp.where(vlo == vhi, flo * kept, total)
+        o_ref[...] = total / kept
+    else:  # pragma: no cover - guarded by fused_finish_compact
+        raise ValueError(f"unknown aggregator {akind!r}")
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("forged_mult", "forge", "agg", "sanitize", "interpret"),
+)
+def fused_finish_compact(
+    updates: jax.Array,
+    forge_noise: Optional[jax.Array] = None,
+    *,
+    forged_mult: int,
+    forge: tuple,
+    agg: tuple = ("median",),
+    sanitize: bool = False,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Forge + aggregate over a BENIGN-ONLY update matrix in one pass.
+
+    The malicious lanes' training was elided (parallel/streamed.py's
+    ``malicious_prefix``), so the stored matrix holds just the ``nb``
+    benign rows; the forged row enters the aggregation as a virtual row
+    of multiplicity ``forged_mult``.  Exactly equivalent to
+    :func:`fused_finish` on the full ``(nb + forged_mult, d)`` matrix
+    with the malicious rows scattered (tests/test_pallas_round.py), at
+    75% of its per-row work and HBM footprint for the benchmark's
+    quarter-byzantine scale.
+
+    Returns ``(agg_vec (d,), sq_norms (nb,), bad (nb,), forged (d,))`` —
+    the caller reconstructs malicious-row norms as ``||forged||^2``.
+    """
+    nb, d = updates.shape
+    if forge is None:
+        raise ValueError("compact finish requires a forge (elision is "
+                         "only sound when forged rows replace training)")
+    if forged_mult <= 0:
+        raise ValueError(f"forged_mult must be positive, got {forged_mult}")
+    n_tot = nb + forged_mult
+    if agg[0] == "trimmed" and n_tot <= 2 * agg[1]:
+        raise ValueError(f"trimmed mean needs > {2 * agg[1]} rows, "
+                         f"got {n_tot}")
+    if forge[0] == "adaptive":
+        if forge_noise is None:
+            raise ValueError("('adaptive', b) forging needs forge_noise")
+        if forge_noise.shape != (d,):
+            raise ValueError(
+                f"forge_noise must be ({d},), got {forge_noise.shape}"
+            )
+        rbuf = forge_noise.astype(jnp.float32)[None, :]
+    else:
+        rbuf = jnp.zeros((1, d), jnp.float32)
+    wb = jnp.ones((nb, 1), jnp.float32)
+    npad = -(-nb // 8) * 8
+    if npad != nb:
+        pad = jnp.full((npad - nb, d), jnp.inf, updates.dtype)
+        updates = jnp.concatenate([updates, pad], axis=0)
+        wb = jnp.concatenate(
+            [wb, jnp.zeros((npad - nb, 1), jnp.float32)], axis=0)
+    dpad = -(-d // _BLOCK_D) * _BLOCK_D
+    if dpad != d:
+        updates = jnp.pad(updates, ((0, 0), (0, dpad - d)))
+    if rbuf.shape[1] != dpad:
+        rbuf = jnp.pad(rbuf, ((0, 0), (0, dpad - rbuf.shape[1])))
+
+    kernel = functools.partial(
+        _compact_kernel, nb_true=nb, mult=forged_mult, forge=forge, agg=agg,
+        sanitize=sanitize, keys16=updates.dtype == jnp.bfloat16,
+    )
+    agg_vec, sq, bad, forged = pl.pallas_call(
+        kernel,
+        grid=(dpad // _BLOCK_D,),
+        in_specs=[
+            pl.BlockSpec((npad, _BLOCK_D), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((npad, 1), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _BLOCK_D), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, _BLOCK_D), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((npad, 1), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((npad, 1), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _BLOCK_D), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, dpad), jnp.float32),
+            jax.ShapeDtypeStruct((npad, 1), jnp.float32),
+            jax.ShapeDtypeStruct((npad, 1), jnp.float32),
+            jax.ShapeDtypeStruct((1, dpad), jnp.float32),
+        ],
+        interpret=interpret,
+    )(updates, wb, rbuf)
+    return agg_vec[0, :d], sq[:nb, 0], bad[:nb, 0] > 0, forged[0, :d]
 
 
 @functools.partial(

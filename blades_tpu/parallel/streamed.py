@@ -226,7 +226,9 @@ def streamed_step(
             0.0,
         )
         chunk = chunk * scale[:, None]
-        if fr.dp_noise_factor:
+        # `is not None` (not truthiness): a traced per-lane scalar can't
+        # be bool()ed — same guard as FedRound.apply_dp (round.py).
+        if fr.dp_noise_factor is not None:
             sigma = fr.dp_noise_factor * fr.dp_clip_threshold
             chunk = chunk + sigma * jax.random.normal(
                 jax.random.fold_in(k_dp, i), chunk.shape, chunk.dtype
@@ -235,7 +237,10 @@ def streamed_step(
 
     @partial(jax.jit, donate_argnums=(0, 1))
     def _train_block(updates_buf, client_opt, params, x, y, lengths,
-                     malicious, sample_keys, train_keys, row0):
+                     malicious, sample_keys, train_keys, row0, buf_row0):
+        """``row0`` indexes the CLIENT arrays; ``buf_row0`` the update
+        matrix row — they differ only on the benign-compacted path,
+        where the matrix stores no malicious-prefix rows."""
         def sl(a):
             return lax.dynamic_slice_in_dim(a, row0, client_block, axis=0)
 
@@ -259,7 +264,7 @@ def streamed_step(
         norms = (jnp.linalg.norm(upd, axis=1) if dp
                  else jnp.zeros((upd.shape[0],), jnp.float32))
         updates_buf = lax.dynamic_update_slice(
-            updates_buf, upd.astype(update_dtype), (row0, 0)
+            updates_buf, upd.astype(update_dtype), (buf_row0, 0)
         )
         client_opt = jax.tree.map(
             lambda full, blk: lax.dynamic_update_slice_in_dim(full, blk, row0, 0),
@@ -349,6 +354,24 @@ def streamed_step(
 
     spec = _fused_spec(fr)
 
+    def _model_d_and_noise(server_state, updates_buf, k_adv):
+        """Model width from the server params themselves (the fused
+        programs are self-contained; buffer columns are stripe-padded
+        past d) + the adaptive forge's pre-drawn uniforms: the dense
+        round's exact per-coordinate draw
+        (AdaptiveAdversary.on_updates_ready with shard=None),
+        zero-extended over the stripe-padding columns (whose all-zero
+        stats forge to 0 regardless of r).  Shared by the full and
+        compact fused finishes, which tests assert equivalent."""
+        d = sum(p.size for p in jax.tree.leaves(server_state.params))
+        noise = None
+        if spec[0] is not None and spec[0][0] == "adaptive":
+            noise = jax.random.uniform(k_adv, (d,), jnp.float32)
+            d_alloc = updates_buf.shape[1]
+            if d_alloc != d:
+                noise = jnp.pad(noise, (0, d_alloc - d))
+        return d, noise
+
     @jax.jit
     def _finish_fused(server_state, updates_buf, malicious, losses, k_adv):
         from blades_tpu.ops.pallas_round import fused_finish
@@ -356,20 +379,8 @@ def streamed_step(
         # No ghost-lane slice here: the fused path is only selected when
         # num_clients == n (a row slice feeding pallas_call would
         # materialize a second near-full copy of the giant matrix).
-        # Model width from the server params themselves, so this program
-        # is self-contained (buffer columns are stripe-padded past d).
-        d = sum(p.size for p in jax.tree.leaves(server_state.params))
+        d, noise = _model_d_and_noise(server_state, updates_buf, k_adv)
         forge, aspec = spec
-        noise = None
-        if forge is not None and forge[0] == "adaptive":
-            # The dense round's exact per-coordinate draw
-            # (AdaptiveAdversary.on_updates_ready with shard=None),
-            # zero-extended over the buffer's stripe-padding columns
-            # (whose all-zero stats forge to 0 regardless of r).
-            noise = jax.random.uniform(k_adv, (d,), jnp.float32)
-            d_alloc = updates_buf.shape[1]
-            if d_alloc != d:
-                noise = jnp.pad(noise, (0, d_alloc - d))
         agg_vec, sq_norms, bad_rows = fused_finish(
             updates_buf, malicious, noise, forge=forge, agg=aspec,
             sanitize=fr.health_check,
@@ -377,6 +388,30 @@ def streamed_step(
         agg_vec = agg_vec[:d]  # drop stripe-alignment padding columns
         return _serve_aggregate(server_state, agg_vec, malicious, losses,
                                 sq_norms, bad_rows)
+
+    @jax.jit
+    def _finish_fused_compact(server_state, updates_buf, malicious, losses,
+                              k_adv):
+        """Fused finish over the benign-compacted matrix: the forged row
+        participates as a virtual row of multiplicity ``malicious_prefix``
+        (ops/pallas_round.fused_finish_compact) — per-row kernel work and
+        matrix HBM both shrink by the byzantine fraction."""
+        from blades_tpu.ops.pallas_round import fused_finish_compact
+
+        d, noise = _model_d_and_noise(server_state, updates_buf, k_adv)
+        forge, aspec = spec
+        agg_vec, sq_b, bad_b, forged = fused_finish_compact(
+            updates_buf, noise, forged_mult=malicious_prefix, forge=forge,
+            agg=aspec, sanitize=fr.health_check,
+        )
+        agg_vec, forged = agg_vec[:d], forged[:d]
+        fsq = forged @ forged
+        sq = jnp.concatenate(
+            [jnp.full((malicious_prefix,), fsq, jnp.float32), sq_b])
+        bad = jnp.concatenate(
+            [jnp.zeros((malicious_prefix,), bool), bad_b])
+        return _serve_aggregate(server_state, agg_vec, malicious, losses,
+                                sq, bad)
 
     # Whether the row-geometry materialization rewrites the buffer at all
     # (when not, the buffer is read-only and one stats pass suffices).
@@ -528,19 +563,6 @@ def streamed_step(
         k_sample, k_train, k_adv, k_agg, k_dp = jax.random.split(key, 5)
         sample_keys = jax.random.split(k_sample, n)
         train_keys = jax.random.split(k_train, n)
-        # The fused pallas finish wants stripe-aligned columns; padding
-        # at allocation (zero columns, sliced off the aggregate) avoids a
-        # whole-matrix pad copy inside the kernel call.
-        if use_fused:
-            from blades_tpu.ops.pallas_select import _BLOCK_D
-
-            d_alloc = -(-d_model // _BLOCK_D) * _BLOCK_D
-        else:
-            d_alloc = d_model
-        updates_buf = jnp.zeros((n, d_alloc), update_dtype)
-        client_opt = state.client_opt
-        if not donate:
-            client_opt = jax.tree.map(jnp.copy, client_opt)
         # Malicious-lane training elision (see malicious_prefix above):
         # blocks fully inside the forged prefix never train — their rows
         # stay zero (finite, benign-invisible) and the forge overwrites
@@ -558,14 +580,44 @@ def streamed_step(
                 # relay), so the check is cached by array identity.
                 import numpy as np
 
-                if not bool(np.asarray(
-                        malicious[:skip_blocks * client_block]).all()):
+                mal_np = np.asarray(malicious)
+                if not (bool(mal_np[:skip_blocks * client_block].all())
+                        and not bool(mal_np[malicious_prefix:].any())):
                     raise ValueError(
-                        f"malicious_prefix={malicious_prefix} promised the "
-                        "first lanes malicious, but the malicious mask "
-                        "disagrees — elision would zero benign updates"
+                        f"malicious_prefix={malicious_prefix} promised "
+                        "exactly the first lanes malicious, but the "
+                        "malicious mask disagrees — elision would zero "
+                        "benign updates (or treat trained malicious lanes "
+                        "as benign on the compacted path)"
                     )
                 _checked_masks.add(id(malicious))
+        # Benign-compacted fused finish: when the whole malicious prefix
+        # is elided block-aligned, the matrix stores ONLY the benign rows
+        # and the forged row enters the order statistics as a virtual row
+        # of multiplicity `malicious_prefix` (fused_finish_compact) —
+        # matrix HBM and per-row kernel work shrink by the byzantine
+        # fraction.
+        nb = n - (malicious_prefix or 0)
+        compact = (spec is not None and no_ghosts and coord_forges
+                   and skip_blocks > 0
+                   and malicious_prefix % client_block == 0
+                   and should_use(nb, d_model))
+        use_fused = use_fused or compact
+        # The fused pallas finish wants stripe-aligned columns; padding
+        # at allocation (zero columns, sliced off the aggregate) avoids a
+        # whole-matrix pad copy inside the kernel call.
+        if use_fused:
+            from blades_tpu.ops.pallas_select import _BLOCK_D
+
+            d_alloc = -(-d_model // _BLOCK_D) * _BLOCK_D
+        else:
+            d_alloc = d_model
+        rows = nb if compact else n
+        row_shift = malicious_prefix if compact else 0
+        updates_buf = jnp.zeros((rows, d_alloc), update_dtype)
+        client_opt = state.client_opt
+        if not donate:
+            client_opt = jax.tree.map(jnp.copy, client_opt)
         losses, norms = [], []
         for b in range(n // client_block):
             if b < skip_blocks:
@@ -576,6 +628,7 @@ def streamed_step(
                 updates_buf, client_opt, state.server.params, data_x, data_y,
                 lengths, malicious, sample_keys, train_keys,
                 jnp.int32(b * client_block),
+                jnp.int32(b * client_block - row_shift),
             )
             losses.append(loss)
             norms.append(blk_norms)
@@ -615,6 +668,11 @@ def streamed_step(
                     state.server, updates_buf, malicious,
                     jnp.concatenate(losses), sq, bad,
                 )
+        elif compact:
+            server, metrics = _finish_fused_compact(
+                state.server, updates_buf, malicious, jnp.concatenate(losses),
+                k_adv,
+            )
         elif use_fused:
             server, metrics = _finish_fused(
                 state.server, updates_buf, malicious, jnp.concatenate(losses),
@@ -628,12 +686,14 @@ def streamed_step(
         return RoundState(server=server, client_opt=client_opt), metrics
 
     # Expose the jitted phases for profiling / inspection.  A round runs
-    # train_block xN then exactly one of the finishes — finish_fused when
-    # the round's config and backend admit the one-pass pallas kernel
-    # (see _fused_spec / pallas_round.should_use), finish otherwise.
-    # finish_fused exists only for configs the kernel covers.
+    # train_block xN then exactly one of the finishes — finish_fused_compact
+    # when the malicious prefix is elided block-aligned and the kernel
+    # applies (the headline benchmark configuration), finish_fused for
+    # full-matrix kernel rounds, finish otherwise.  The fused handles
+    # exist only for configs the kernel covers.
     step.train_block = _train_block
     step.finish = _finish
     if spec is not None:
         step.finish_fused = _finish_fused
+        step.finish_fused_compact = _finish_fused_compact
     return step

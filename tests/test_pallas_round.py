@@ -207,3 +207,149 @@ def test_streamed_step_fused_branch_matches_chunked(monkeypatch):
     for a, b in zip(p1, p2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5,
                                    atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Benign-compacted finish (virtual forged-row multiplicity)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("nb,mult,d", [(24, 8, 1000), (17, 5, 700),
+                                       (18, 6, 600), (11, 13, 520)])
+@pytest.mark.parametrize(
+    "forge,agg",
+    [
+        (("alie", 0.7), ("median",)),
+        (("alie", 0.7), ("mean",)),
+        (("ipm", 1.5), ("trimmed", 3)),
+        (("ipm", 1.5), ("median",)),
+    ],
+)
+def test_compact_matches_full_kernel(nb, mult, d, forge, agg):
+    """The compact kernel over nb benign rows + a virtual forged row of
+    multiplicity `mult` must equal the FULL kernel over the
+    (nb + mult, d) matrix whose first `mult` rows are malicious."""
+    from blades_tpu.ops.pallas_round import fused_finish_compact
+
+    if agg[0] == "trimmed" and nb + mult <= 2 * agg[1]:
+        pytest.skip("overtrimmed")
+    rng = np.random.default_rng(seed=nb * 31 + d)
+    xb = jnp.asarray(rng.normal(size=(nb, d)), jnp.float32)
+    # Full matrix: malicious prefix rows hold garbage the forge replaces.
+    garbage = jnp.asarray(rng.normal(size=(mult, d)) * 50.0, jnp.float32)
+    x_full = jnp.concatenate([garbage, xb], axis=0)
+    mal = jnp.arange(nb + mult) < mult
+
+    a_full, sq_full, bad_full = fused_finish(
+        x_full, mal, forge=forge, agg=agg, sanitize=True, interpret=True)
+    a_c, sq_c, bad_c, forged = fused_finish_compact(
+        xb, forged_mult=mult, forge=forge, agg=agg, sanitize=True,
+        interpret=True)
+
+    np.testing.assert_allclose(np.asarray(a_full), np.asarray(a_c),
+                               atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(sq_full[mult:]), np.asarray(sq_c),
+                               rtol=1e-6)
+    # Malicious rows' norms are ||forged||^2 — reconstructable outside.
+    np.testing.assert_allclose(
+        np.asarray(sq_full[:mult]),
+        np.full(mult, float(forged @ forged)), rtol=1e-5)
+    assert not np.asarray(bad_c).any()
+
+
+def test_compact_bf16_matches_full_bf16():
+    from blades_tpu.ops.pallas_round import fused_finish_compact
+
+    nb, mult, d = 24, 8, 800
+    rng = np.random.default_rng(3)
+    xb = jnp.asarray(rng.normal(size=(nb, d)), jnp.bfloat16)
+    x_full = jnp.concatenate(
+        [jnp.zeros((mult, d), jnp.bfloat16), xb], axis=0)
+    mal = jnp.arange(nb + mult) < mult
+    for agg in (("median",), ("trimmed", 5), ("mean",)):
+        a_full, _, _ = fused_finish(x_full, mal, forge=("alie", 1.2),
+                                    agg=agg, interpret=True)
+        a_c, _, _, _ = fused_finish_compact(
+            xb, forged_mult=mult, forge=("alie", 1.2), agg=agg,
+            interpret=True)
+        np.testing.assert_allclose(np.asarray(a_full), np.asarray(a_c),
+                                   atol=2e-4, rtol=1e-4)
+
+
+def test_compact_adaptive_matches_full():
+    from blades_tpu.ops.pallas_round import fused_finish_compact
+
+    nb, mult, d = 16, 6, 520
+    rng = np.random.default_rng(5)
+    xb = jnp.asarray(rng.normal(size=(nb, d)), jnp.float32)
+    x_full = jnp.concatenate([jnp.ones((mult, d)) * 9.0, xb], axis=0)
+    mal = jnp.arange(nb + mult) < mult
+    noise = jnp.asarray(rng.random(d), jnp.float32)
+    a_full, _, _ = fused_finish(x_full, mal, noise,
+                                forge=("adaptive", 2.0), agg=("median",),
+                                interpret=True)
+    a_c, _, _, _ = fused_finish_compact(
+        xb, noise, forged_mult=mult, forge=("adaptive", 2.0),
+        agg=("median",), interpret=True)
+    np.testing.assert_allclose(np.asarray(a_full), np.asarray(a_c),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_compact_rejects_forgeless():
+    from blades_tpu.ops.pallas_round import fused_finish_compact
+
+    with pytest.raises(ValueError, match="forge"):
+        fused_finish_compact(jnp.zeros((8, 600)), forged_mult=2,
+                             forge=None, interpret=True)
+
+
+def test_streamed_step_compact_branch_matches_chunked(monkeypatch):
+    """Force the streamed round onto the benign-compacted fused finish
+    (elided malicious prefix + virtual-multiplicity kernel, interpret
+    mode) and check the whole round matches the chunked finish."""
+    import functools
+
+    from blades_tpu import parallel
+    from blades_tpu.adversaries import get_adversary, make_malicious_mask
+    from blades_tpu.core import FedRound, Server, TaskSpec
+    from blades_tpu.ops import pallas_round
+
+    monkeypatch.setattr(pallas_round, "should_use", lambda n, d: True)
+    monkeypatch.setattr(
+        pallas_round, "fused_finish_compact",
+        functools.partial(pallas_round.fused_finish_compact.__wrapped__,
+                          interpret=True),
+    )
+
+    n, f = 12, 4  # f divisible by client_block -> compact path
+    task = TaskSpec(model="mlp", input_shape=(8, 8, 1), num_classes=10,
+                    lr=0.1).build()
+    server = Server.from_config(aggregator="Median", lr=0.5)
+    adv = get_adversary("ALIE", num_clients=n, num_byzantine=f)
+    fr = FedRound(task=task, server=server, adversary=adv, batch_size=4,
+                  num_batches_per_round=1)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(n, 8, 8, 8, 1)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 10, size=(n, 8)), jnp.int32)
+    lengths = jnp.full((n,), 8, jnp.int32)
+    mal = make_malicious_mask(n, f)
+    key = jax.random.PRNGKey(3)
+
+    state0 = fr.init(jax.random.PRNGKey(0), n)
+    step_compact = parallel.streamed.streamed_step(
+        fr, client_block=4, update_dtype=jnp.float32, donate=False,
+        malicious_prefix=f)
+    s1, m1 = step_compact(state0, x, y, lengths, mal, key)
+
+    monkeypatch.setattr(pallas_round, "should_use", lambda n, d: False)
+    state0 = fr.init(jax.random.PRNGKey(0), n)
+    step_chunked = parallel.streamed.streamed_step(
+        fr, client_block=4, update_dtype=jnp.float32, donate=False)
+    s2, m2 = step_chunked(state0, x, y, lengths, mal, key)
+
+    for k in ("train_loss", "agg_norm", "update_norm_mean"):
+        np.testing.assert_allclose(float(m1[k]), float(m2[k]), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(s1.server.params),
+                    jax.tree.leaves(s2.server.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5,
+                                   atol=1e-6)
