@@ -94,7 +94,8 @@ def run_lanes(
     keys_set = {frozenset(o.keys()) for o in lane_overrides}
     if len(keys_set) != 1:
         raise ValueError("all lanes must override the same keys")
-    unknown = set(next(iter(keys_set))) - set(LANE_KEYS)
+    ok = next(iter(keys_set))
+    unknown = set(ok) - set(LANE_KEYS)
     if unknown:
         raise ValueError(f"not lane-traceable: {sorted(unknown)}")
 
@@ -116,13 +117,20 @@ def run_lanes(
     fr = base.get_fed_round()
     if getattr(fr.server.aggregator, "expects_trusted_row", False):
         raise ValueError("trust-bootstrapped aggregators are not lane-able")
+    if "server_lr" in ok and base.lr_schedule:
+        # lr_schedule() compares/divides schedule points (server.py),
+        # which a traced per-lane lr cannot survive — the failure would
+        # otherwise surface as an opaque TracerBoolConversionError.
+        raise ValueError(
+            "server_lr lanes are incompatible with a configured "
+            "lr_schedule; drop the schedule or run these trials "
+            "sequentially"
+        )
 
     seeds = [c.seed for c in cfgs]
     # Traced scalar lanes, one per overridden knob (seed is handled via
     # data/keys; dp_epsilon reaches the program as the derived noise
     # factor validate() computed).
-    ok = next(iter(keys_set))
-
     def arr(field):
         return jnp.asarray([float(getattr(c, field)) for c in cfgs],
                            jnp.float32)

@@ -10,6 +10,7 @@ import time
 from pathlib import Path
 from typing import Any, Dict, List, Optional, Tuple
 
+import numpy as np
 import yaml
 
 
@@ -105,13 +106,29 @@ def _get_path(cfg, path):
 def _lane_signature(trial: Dict):
     """(signature-json, {lane_key: value}) — trials with equal signatures
     differ only in lane-traceable knobs."""
-    sig = copy.deepcopy(trial)
-    overrides = {}
+    present_paths = {}
+    conflict = False
     for path, key in _LANE_PATHS.items():
         val, present = _get_path(trial, path)
         if present and not isinstance(val, (dict, list)):
-            overrides[key] = val
-            _set_path(sig, path, _LANE_SENTINEL)
+            if key in present_paths and present_paths[key][1] != val:
+                # Two config paths alias the same lane knob (e.g. both
+                # `seed` and `dataset_config.seed`) with DIFFERENT
+                # values — laning would silently pick one.  Keep such a
+                # trial out of lane grouping entirely (its signature is
+                # its raw config, so only literally identical trials
+                # could share it, with no overrides to mis-apply).
+                conflict = True
+            else:
+                present_paths[key] = (path, val)
+    if conflict:
+        sig = dict(trial, __lane_conflict__=True)
+        return json.dumps(sig, sort_keys=True, default=str), {}
+    sig = copy.deepcopy(trial)
+    overrides = {}
+    for key, (path, val) in present_paths.items():
+        overrides[key] = val
+        _set_path(sig, path, _LANE_SENTINEL)
     return json.dumps(sig, sort_keys=True, default=str), overrides
 
 
@@ -140,12 +157,44 @@ def _lanes_eligible(spec_run: str, trial: Dict, group: List[int]) -> bool:
         cfg.validate()
     except Exception:
         return False
-    return (
+    if not (
         cfg.execution in ("auto", "dense")
         and cfg.num_clients <= 200
         and not cfg.num_devices
         and int(getattr(cfg, "rounds_per_dispatch", 1)) == 1
-    )
+    ):
+        return False
+    if cfg.lr_schedule:
+        _, ov = _lane_signature(trial)
+        if "server_lr" in ov:
+            # Statically known incompatibility (the schedule interpolation
+            # cannot take a traced lr) — skip the group cheaply instead of
+            # letting run_lanes raise after building the model.
+            return False
+    # Bound the vmapped update-matrix footprint (L x n x d f32): a
+    # sequential 'auto' trial above the dense budget would stream, but
+    # lanes have no streamed formulation — an eligible-looking group
+    # would compile-OOM (wasted work) or run with different numerics
+    # than the sequential run it must reproduce.
+    from blades_tpu.algorithms.fedavg import Fedavg
+    from blades_tpu.utils.tree import tree_size
+
+    try:
+        import jax
+
+        params_shape = jax.eval_shape(
+            lambda: cfg.get_task_spec().build().init_params(
+                jax.random.PRNGKey(0))
+        )
+        d = tree_size(params_shape)
+    except Exception as exc:
+        import warnings
+
+        warnings.warn(f"lane eligibility probe failed for group {group}: "
+                      f"{type(exc).__name__}: {exc}", RuntimeWarning)
+        return False
+    lane_bytes = len(group) * cfg.num_clients * d * 4
+    return lane_bytes <= Fedavg._DENSE_MATRIX_HBM_LIMIT
 
 
 # ---------------------------------------------------------------------------
@@ -338,6 +387,7 @@ def run_experiments(
         # Vmapped lane groups (concurrent-trial analogue).  Incompatible
         # with checkpoint/resume/fault handling, which stay sequential.
         laned: Dict[int, Dict] = {}
+        lane_failed: Dict[int, str] = {}
         if (lanes and not resume and not checkpoint_freq
                 and not checkpoint_at_end and max_failures == 0):
             for group in lane_groups(trials):
@@ -349,10 +399,20 @@ def run_experiments(
                         root, verbose,
                     ))
                 except Exception as exc:
-                    if verbose:
-                        print(f"   .. lane group {group} fell back to "
-                              f"sequential ({type(exc).__name__}: {exc})",
-                              flush=True)
+                    # LOUD fallback: a lane-group failure means the
+                    # concurrent path silently diverged from sequential
+                    # capability — always warn and stamp the affected
+                    # trials' summaries, never swallow.
+                    import warnings
+
+                    msg = f"{type(exc).__name__}: {exc}"
+                    warnings.warn(
+                        f"lane group {exp_name}{group} fell back to "
+                        f"sequential execution ({msg})", RuntimeWarning)
+                    print(f"   !! lane group {group} fell back to "
+                          f"sequential ({msg})", flush=True)
+                    for i in group:
+                        lane_failed[i] = msg
 
         for i, trial_cfg in enumerate(trials):
             if i in laned:
@@ -472,6 +532,8 @@ def run_experiments(
                 summary["error"] = failed_error
             if resumed_from is not None:
                 summary["resumed"] = f"from round {resumed_from}"
+            if i in lane_failed:
+                summary["lane_fallback"] = lane_failed[i]
             if verbose:
                 print(f"   -> {summary}", flush=True)
             summaries.append(summary)
@@ -479,7 +541,6 @@ def run_experiments(
 
 
 def _jsonable(obj):
-    import numpy as np
 
     if isinstance(obj, dict):
         return {k: _jsonable(v) for k, v in obj.items()}

@@ -45,3 +45,160 @@ def test_seed_lanes_match_sequential_driver():
 
     # Distinct seeds actually produce distinct trials.
     assert lanes[0][0]["train_loss"] != lanes[1][0]["train_loss"]
+
+
+# ---------------------------------------------------------------------------
+# Round-4: the default-on sweep lane path (VERDICT r3 item 2)
+# ---------------------------------------------------------------------------
+
+
+def _dp_experiment(rounds, seeds, epsilons):
+    """The canonical DP grid (tuned_examples/fedavg_dp.yaml shape),
+    scaled down for CI."""
+    return {
+        "fedavg_dp_ci": {
+            "run": "FEDAVG_DP",
+            "stop": {"training_iteration": rounds},
+            "config": {
+                "dataset_config": {
+                    "type": "mnist", "num_clients": 6, "train_bs": 16,
+                    "seed": {"grid_search": seeds},
+                },
+                "global_model": "mlp",
+                "evaluation_interval": 2,
+                "dp_epsilon": {"grid_search": epsilons},
+                "dp_delta": 1.0e-6,
+                "dp_clip_threshold": 1.0,
+                "server_config": {"lr": 1.0, "aggregator": {"type": "Mean"}},
+            },
+        }
+    }
+
+
+def test_dp_grid_runs_as_lanes_with_result_parity(tmp_path):
+    """The r2 'done' bar: the DP epsilon x seed grid runs as ONE vmapped
+    lane group from the YAML-shaped experiment path, with per-row result
+    parity against lanes=False."""
+    import json
+
+    from blades_tpu.tune.sweep import run_experiments
+
+    rounds = 3
+    exp = _dp_experiment(rounds, seeds=[121, 122], epsilons=[1.0, 100.0])
+    s_lanes = run_experiments(exp, storage_path=str(tmp_path / "lanes"),
+                              verbose=0, lanes=True)
+    assert all(s.get("lanes") == 4 for s in s_lanes), s_lanes
+    s_seq = run_experiments(exp, storage_path=str(tmp_path / "seq"),
+                            verbose=0, lanes=False)
+    assert not any("lanes" in s for s in s_seq)
+
+    for sl, ss in zip(s_lanes, s_seq):
+        rows_l = [json.loads(line) for line in
+                  open(f"{sl['dir']}/result.json")]
+        rows_s = [json.loads(line) for line in
+                  open(f"{ss['dir']}/result.json")]
+        assert len(rows_l) == len(rows_s) == rounds
+        for rl, rs in zip(rows_l, rows_s):
+            assert rl["training_iteration"] == rs["training_iteration"]
+            np.testing.assert_allclose(rl["train_loss"], rs["train_loss"],
+                                       rtol=2e-4)
+            if "test_acc" in rs:
+                np.testing.assert_allclose(rl["test_acc"], rs["test_acc"],
+                                           atol=0.02)
+
+
+def test_lane_groups_mixed_knobs_and_singletons():
+    """Static knobs split groups; lane knobs merge them; singletons fall
+    through to sequential."""
+    from blades_tpu.tune.sweep import _lanes_eligible, lane_groups
+
+    trials = [
+        {"global_model": "mlp", "seed": 1, "server_config": {"lr": 1.0}},
+        {"global_model": "mlp", "seed": 2, "server_config": {"lr": 1.0}},
+        {"global_model": "mlp", "seed": 1, "server_config": {"lr": 0.5}},
+        # different STATIC knob -> its own group
+        {"global_model": "cnn", "seed": 1, "server_config": {"lr": 1.0}},
+    ]
+    groups = {tuple(g) for g in lane_groups(trials)}
+    # trials 0-2 differ only in (seed, server_lr) -> one group; trial 3 alone
+    assert groups == {(0, 1, 2), (3,)}
+    assert not _lanes_eligible("FEDAVG", trials[3], [3])  # singleton
+
+
+def test_lane_signature_seed_path_conflict_stays_sequential():
+    """A trial carrying BOTH `seed` and `dataset_config.seed` with
+    different values must not be laned (laning would silently pick one)."""
+    from blades_tpu.tune.sweep import _lane_signature, lane_groups
+
+    t1 = {"seed": 1, "dataset_config": {"type": "mnist", "seed": 7}}
+    t2 = {"seed": 2, "dataset_config": {"type": "mnist", "seed": 9}}
+    sig1, ov1 = _lane_signature(t1)
+    assert ov1 == {}
+    assert "__lane_conflict__" in sig1
+    groups = {tuple(g) for g in lane_groups([t1, t2])}
+    assert groups == {(0,), (1,)}
+
+    # Aligned values are NOT a conflict.
+    t3 = {"seed": 5, "dataset_config": {"type": "mnist", "seed": 5}}
+    _, ov3 = _lane_signature(t3)
+    assert ov3.get("seed") == 5
+
+
+def test_lanes_eligible_bounds_update_matrix_hbm():
+    """A group whose stacked L x n x d update matrix would exceed the
+    dense-HBM budget must not lane (the sequential driver would stream)."""
+    from blades_tpu.tune.sweep import _lanes_eligible
+
+    trial = {
+        "dataset_config": {"type": "cifar10", "num_clients": 200, "seed": 1},
+        "global_model": "resnet18",  # 11.2M params
+        "server_config": {"lr": 1.0, "aggregator": {"type": "Mean"}},
+    }
+    # 200 clients x 11.2M x 4 B = 8.9 GB per lane: even 2 lanes blow the
+    # 6 GB dense budget.
+    assert not _lanes_eligible("FEDAVG", trial, [0, 1])
+    small = {
+        "dataset_config": {"type": "mnist", "num_clients": 6, "seed": 1},
+        "global_model": "mlp",
+        "server_config": {"lr": 1.0, "aggregator": {"type": "Mean"}},
+    }
+    assert _lanes_eligible("FEDAVG", small, [0, 1])
+
+
+def test_server_lr_lanes_reject_lr_schedule():
+    """A laned server_lr with a configured lr_schedule must fail loudly
+    (the schedule interpolation cannot take a traced lr)."""
+    import pytest
+
+    from blades_tpu.tune.lanes import run_lanes
+
+    def builder():
+        cfg = _config()
+        cfg.lr_schedule = [[0, 1.0], [10, 0.1]]
+        return cfg
+
+    with pytest.raises(ValueError, match="lr_schedule"):
+        run_lanes(builder, [{"server_lr": 1.0}, {"server_lr": 0.5}],
+                  max_rounds=1)
+
+
+def test_lane_group_failure_is_loud(tmp_path, monkeypatch):
+    """A lane-group crash must warn, stamp the trials' summaries, and
+    still run them sequentially."""
+    import warnings
+
+    import blades_tpu.tune.sweep as sweep_mod
+
+    def boom(*a, **k):
+        raise RuntimeError("lane boom")
+
+    monkeypatch.setattr(sweep_mod, "_run_lane_group", boom)
+    exp = _dp_experiment(2, seeds=[121, 122], epsilons=[1.0])
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        summaries = sweep_mod.run_experiments(
+            exp, storage_path=str(tmp_path), verbose=0, lanes=True)
+    assert any("fell back to sequential" in str(x.message) for x in w)
+    assert all(s.get("lane_fallback", "").endswith("lane boom")
+               for s in summaries), summaries
+    assert all(s["rounds"] == 2 for s in summaries)
