@@ -284,11 +284,9 @@ class FedavgConfig:
                     "execution='streamed' is the single-chip giant-federation "
                     "path; use the mesh (num_devices>1) for multi-chip"
                 )
-            if self.rounds_per_dispatch > 1:
-                raise ValueError(
-                    "execution='streamed' dispatches per client block; "
-                    "rounds_per_dispatch must be 1"
-                )
+            # rounds_per_dispatch > 1 chains k streamed rounds through the
+            # dispatch pipeline with no host sync between them
+            # (parallel/streamed.streamed_multi_step).
         if str(self.update_dtype) not in ("bfloat16", "float32"):
             raise ValueError(
                 f"update_dtype must be 'bfloat16' or 'float32', got "

@@ -127,8 +127,7 @@ class Fedavg:
             if cd is not None:
                 x, y, ln = self._train_arrays
                 self._train_arrays = (x.astype(jnp.dtype(cd)), y, ln)
-            self._step = streamed_step(
-                self.fed_round,
+            streamed_kw = dict(
                 client_block=self._streamed_block(),
                 d_chunk=cfg.d_chunk,
                 update_dtype=getattr(jnp, str(cfg.update_dtype)),
@@ -137,6 +136,13 @@ class Fedavg:
                 # skip the dead malicious-lane training blocks.
                 malicious_prefix=cfg.num_malicious_clients,
             )
+            if self._chunk > 1:
+                from blades_tpu.parallel.streamed import streamed_multi_step
+
+                self._step = streamed_multi_step(
+                    self.fed_round, self._chunk, **streamed_kw)
+            else:
+                self._step = streamed_step(self.fed_round, **streamed_kw)
             self._evaluate = jax.jit(self.fed_round.evaluate)
         else:
             if self._chunk > 1:
@@ -184,8 +190,6 @@ class Fedavg:
             return False
         if cfg.execution == "streamed":
             return True
-        if self._chunk > 1:
-            return False  # multi-round fusion needs the dense program
         from blades_tpu.parallel.streamed import (
             _COORDWISE_AGGREGATORS,
             _COORDWISE_FORGERS,

@@ -123,10 +123,15 @@ def _kth_key16_mult(keys, k, fkey, mult: int):
 
 def _next_key16_above_mult(keys, v, fkey):
     """Smallest key strictly greater than ``v`` over keys + the virtual
-    forged key."""
+    forged key.  Mosaic has no unsigned min; 16-bit keys (<= 0x10000)
+    fit int32 with order preserved."""
     nxt = _next_key16_above(keys, v)
     fnext = jnp.where(fkey > v, fkey, jnp.uint32(0x10000))
-    return jnp.minimum(nxt, fnext)
+    m = jnp.minimum(
+        jax.lax.bitcast_convert_type(nxt, jnp.int32),
+        jax.lax.bitcast_convert_type(fnext, jnp.int32),
+    )
+    return jax.lax.bitcast_convert_type(m, jnp.uint32)
 
 
 def _kth_key_mult(keys, k, fkey, mult: int):
@@ -142,12 +147,17 @@ def _kth_key_mult(keys, k, fkey, mult: int):
 
 
 def _next_key_above_mult(keys, v, fkey):
+    """Full-width variant; the min runs in int32 space via the
+    order-preserving ``u ^ 0x8000_0000`` bias (no unsigned min in
+    Mosaic)."""
     nxt = _next_key_above(keys, v)
-    # 0xFFFFFFFF (the +inf/NaN key) is its own successor ceiling; the
-    # unsigned compare is safe in uint32 space here because fkey is a
-    # finite value's key.
     fnext = jnp.where(fkey > v, fkey, jnp.uint32(0xFFFFFFFF))
-    return jnp.minimum(nxt, fnext)
+    bias = jnp.uint32(0x80000000)
+    m = jnp.minimum(
+        jax.lax.bitcast_convert_type(nxt ^ bias, jnp.int32),
+        jax.lax.bitcast_convert_type(fnext ^ bias, jnp.int32),
+    )
+    return jax.lax.bitcast_convert_type(m, jnp.uint32) ^ bias
 
 
 def _forged_stripe(xs, wb, r_ref, forge, keys16: bool):
@@ -379,7 +389,8 @@ def _compact_kernel(x_ref, wb_ref, r_ref, o_ref, sq_ref, bad_ref, fr_ref, *,
 
 @functools.partial(
     jax.jit,
-    static_argnames=("forged_mult", "forge", "agg", "sanitize", "interpret"),
+    static_argnames=("forged_mult", "forge", "agg", "sanitize", "num_real",
+                     "interpret"),
 )
 def fused_finish_compact(
     updates: jax.Array,
@@ -389,6 +400,7 @@ def fused_finish_compact(
     forge: tuple,
     agg: tuple = ("median",),
     sanitize: bool = False,
+    num_real: Optional[int] = None,
     interpret: bool = False,
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Forge + aggregate over a BENIGN-ONLY update matrix in one pass.
@@ -404,8 +416,17 @@ def fused_finish_compact(
 
     Returns ``(agg_vec (d,), sq_norms (nb,), bad (nb,), forged (d,))`` —
     the caller reconstructs malicious-row norms as ``||forged||^2``.
+
+    ``num_real``: benign row count when the CALLER pre-padded the matrix
+    to a sublane multiple with +inf rows (row padding here would
+    concat-copy the giant matrix; the streamed round allocates padded
+    and writes the +inf rows once).  Default: every row is real.
     """
     nb, d = updates.shape
+    if num_real is not None:
+        if not (0 < num_real <= nb):
+            raise ValueError(f"num_real={num_real} out of range for {nb} rows")
+        nb = num_real
     if forge is None:
         raise ValueError("compact finish requires a forge (elision is "
                          "only sound when forged rows replace training)")
@@ -425,13 +446,21 @@ def fused_finish_compact(
         rbuf = forge_noise.astype(jnp.float32)[None, :]
     else:
         rbuf = jnp.zeros((1, d), jnp.float32)
-    wb = jnp.ones((nb, 1), jnp.float32)
-    npad = -(-nb // 8) * 8
-    if npad != nb:
-        pad = jnp.full((npad - nb, d), jnp.inf, updates.dtype)
-        updates = jnp.concatenate([updates, pad], axis=0)
-        wb = jnp.concatenate(
-            [wb, jnp.zeros((npad - nb, 1), jnp.float32)], axis=0)
+    if num_real is not None:
+        # Caller pre-padded to a sublane multiple with +inf rows.
+        npad = updates.shape[0]
+        if npad % 8:
+            raise ValueError(
+                f"pre-padded matrix height {npad} is not a sublane multiple")
+        wb = (jnp.arange(npad) < nb).astype(jnp.float32)[:, None]
+    else:
+        wb = jnp.ones((nb, 1), jnp.float32)
+        npad = -(-nb // 8) * 8
+        if npad != nb:
+            pad = jnp.full((npad - nb, d), jnp.inf, updates.dtype)
+            updates = jnp.concatenate([updates, pad], axis=0)
+            wb = jnp.concatenate(
+                [wb, jnp.zeros((npad - nb, 1), jnp.float32)], axis=0)
     dpad = -(-d // _BLOCK_D) * _BLOCK_D
     if dpad != d:
         updates = jnp.pad(updates, ((0, 0), (0, dpad - d)))

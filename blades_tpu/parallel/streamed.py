@@ -389,20 +389,22 @@ def streamed_step(
         return _serve_aggregate(server_state, agg_vec, malicious, losses,
                                 sq_norms, bad_rows)
 
-    @jax.jit
+    @partial(jax.jit, static_argnames=("nb_real",))
     def _finish_fused_compact(server_state, updates_buf, malicious, losses,
-                              k_adv):
+                              k_adv, nb_real):
         """Fused finish over the benign-compacted matrix: the forged row
         participates as a virtual row of multiplicity ``malicious_prefix``
         (ops/pallas_round.fused_finish_compact) — per-row kernel work and
-        matrix HBM both shrink by the byzantine fraction."""
+        matrix HBM both shrink by the byzantine fraction.  ``nb_real`` is
+        the benign row count; rows past it are the caller's +inf sublane
+        padding."""
         from blades_tpu.ops.pallas_round import fused_finish_compact
 
         d, noise = _model_d_and_noise(server_state, updates_buf, k_adv)
         forge, aspec = spec
         agg_vec, sq_b, bad_b, forged = fused_finish_compact(
             updates_buf, noise, forged_mult=malicious_prefix, forge=forge,
-            agg=aspec, sanitize=fr.health_check,
+            agg=aspec, sanitize=fr.health_check, num_real=nb_real,
         )
         agg_vec, forged = agg_vec[:d], forged[:d]
         fsq = forged @ forged
@@ -530,6 +532,15 @@ def streamed_step(
     d_model = None  # resolved from params on first call
     _checked_masks: set = set()  # mask ids whose prefix promise was verified
 
+    @partial(jax.jit, static_argnames=("rows", "nb", "d"))
+    def _alloc_row_padded(rows, nb, d):
+        """The compact matrix with its +inf sublane-padding rows built in
+        ONE program (zeros-then-set would transiently hold two copies of
+        a near-HBM-sized buffer)."""
+        col = jnp.where(jnp.arange(rows) >= nb,
+                        jnp.inf, 0.0).astype(update_dtype)
+        return jnp.broadcast_to(col[:, None], (rows, d))
+
     def step(state: RoundState, data_x, data_y, lengths, malicious, key):
         nonlocal d_model
         n = data_x.shape[0]
@@ -597,11 +608,15 @@ def streamed_step(
         # of multiplicity `malicious_prefix` (fused_finish_compact) —
         # matrix HBM and per-row kernel work shrink by the byzantine
         # fraction.
+        from blades_tpu.ops.pallas_select import kernel_applicable
+
         nb = n - (malicious_prefix or 0)
+        # No nb % 8 gate: the buffer is allocated pre-padded to a sublane
+        # multiple with +inf rows the kernel excludes via num_real.
         compact = (spec is not None and no_ghosts and coord_forges
                    and skip_blocks > 0
                    and malicious_prefix % client_block == 0
-                   and should_use(nb, d_model))
+                   and kernel_applicable(nb, d_model))
         use_fused = use_fused or compact
         # The fused pallas finish wants stripe-aligned columns; padding
         # at allocation (zero columns, sliced off the aggregate) avoids a
@@ -612,9 +627,12 @@ def streamed_step(
             d_alloc = -(-d_model // _BLOCK_D) * _BLOCK_D
         else:
             d_alloc = d_model
-        rows = nb if compact else n
+        rows = -(-nb // 8) * 8 if compact else n
         row_shift = malicious_prefix if compact else 0
-        updates_buf = jnp.zeros((rows, d_alloc), update_dtype)
+        if compact and rows != nb:
+            updates_buf = _alloc_row_padded(rows, nb, d_alloc)
+        else:
+            updates_buf = jnp.zeros((rows, d_alloc), update_dtype)
         client_opt = state.client_opt
         if not donate:
             client_opt = jax.tree.map(jnp.copy, client_opt)
@@ -671,7 +689,7 @@ def streamed_step(
         elif compact:
             server, metrics = _finish_fused_compact(
                 state.server, updates_buf, malicious, jnp.concatenate(losses),
-                k_adv,
+                k_adv, nb_real=nb,
             )
         elif use_fused:
             server, metrics = _finish_fused(
@@ -697,3 +715,44 @@ def streamed_step(
         step.finish_fused = _finish_fused
         step.finish_fused_compact = _finish_fused_compact
     return step
+
+
+def streamed_multi_step(
+    fr: FedRound,
+    num_rounds: int,
+    **kw,
+) -> Callable:
+    """``rounds_per_dispatch`` for the streamed path: chain ``num_rounds``
+    streamed rounds without ANY host synchronization between them.
+
+    The streamed round is a host loop of donated async dispatches, so
+    "one dispatch" cannot mean one XLA program the way the dense
+    ``FedRound.multi_step`` scan does — but the property that matters is
+    the same: the driver never blocks between rounds.  Every training
+    block and finish of all ``num_rounds`` rounds is enqueued
+    back-to-back through the dispatch pipeline (donated buffers chain
+    round r's outputs into round r+1), and the per-round relay latency
+    floor is paid once per CHAIN, not once per round.
+
+    Same RNG stream as ``multi_step`` (``split(key, num_rounds)``, round
+    r consuming ``keys[r]``), so at f32 storage the chained rounds are
+    bit-identical to both the dense scan and ``num_rounds`` sequential
+    ``streamed_step`` calls.  Metrics come back stacked
+    ``(num_rounds, ...)`` like ``multi_step``'s.  The caller's
+    ``state.client_opt`` is donated (pass ``donate=False`` in ``kw`` to
+    keep it).
+    """
+    step = streamed_step(fr, **kw)
+
+    def multi(state: RoundState, data_x, data_y, lengths, malicious, key):
+        keys = jax.random.split(key, num_rounds)
+        all_metrics = []
+        for r in range(num_rounds):
+            state, m = step(state, data_x, data_y, lengths, malicious,
+                            keys[r])
+            all_metrics.append(m)
+        metrics = jax.tree.map(lambda *vs: jnp.stack(vs), *all_metrics)
+        return state, metrics
+
+    multi.step = step
+    return multi

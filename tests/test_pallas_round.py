@@ -312,9 +312,11 @@ def test_streamed_step_compact_branch_matches_chunked(monkeypatch):
     from blades_tpu import parallel
     from blades_tpu.adversaries import get_adversary, make_malicious_mask
     from blades_tpu.core import FedRound, Server, TaskSpec
-    from blades_tpu.ops import pallas_round
+    from blades_tpu.ops import pallas_round, pallas_select
 
     monkeypatch.setattr(pallas_round, "should_use", lambda n, d: True)
+    monkeypatch.setattr(pallas_select, "kernel_applicable",
+                        lambda n, d: True)
     monkeypatch.setattr(
         pallas_round, "fused_finish_compact",
         functools.partial(pallas_round.fused_finish_compact.__wrapped__,
@@ -342,6 +344,95 @@ def test_streamed_step_compact_branch_matches_chunked(monkeypatch):
     s1, m1 = step_compact(state0, x, y, lengths, mal, key)
 
     monkeypatch.setattr(pallas_round, "should_use", lambda n, d: False)
+    monkeypatch.setattr(pallas_select, "kernel_applicable",
+                        lambda n, d: False)
+    state0 = fr.init(jax.random.PRNGKey(0), n)
+    step_chunked = parallel.streamed.streamed_step(
+        fr, client_block=4, update_dtype=jnp.float32, donate=False)
+    s2, m2 = step_chunked(state0, x, y, lengths, mal, key)
+
+    for k in ("train_loss", "agg_norm", "update_norm_mean"):
+        np.testing.assert_allclose(float(m1[k]), float(m2[k]), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(s1.server.params),
+                    jax.tree.leaves(s2.server.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5,
+                                   atol=1e-6)
+
+
+def test_compact_caller_prepadded_rows_match_autopad():
+    """num_real + caller +inf padding (the no-copy giant-scale path) must
+    equal the concat-padding path."""
+    from blades_tpu.ops.pallas_round import fused_finish_compact
+
+    nb, mult, d = 11, 5, 600  # nb % 8 != 0
+    rng = np.random.default_rng(9)
+    xb = jnp.asarray(rng.normal(size=(nb, d)), jnp.float32)
+    npad = -(-nb // 8) * 8
+    x_pad = jnp.concatenate(
+        [xb, jnp.full((npad - nb, d), jnp.inf, jnp.float32)], axis=0)
+    for agg in (("median",), ("trimmed", 3), ("mean",)):
+        a1, sq1, bad1, f1 = fused_finish_compact(
+            xb, forged_mult=mult, forge=("alie", 0.9), agg=agg,
+            sanitize=True, interpret=True)
+        a2, sq2, bad2, f2 = fused_finish_compact(
+            x_pad, forged_mult=mult, forge=("alie", 0.9), agg=agg,
+            sanitize=True, num_real=nb, interpret=True)
+        # 1-ulp tolerance: the two wrappers build wb differently (concat
+        # vs arange-compare), and XLA's CPU pipeline reassociates the
+        # forge-stat reductions differently around them.
+        np.testing.assert_allclose(np.asarray(a1), np.asarray(a2),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(sq1), np.asarray(sq2),
+                                   rtol=1e-5)
+        np.testing.assert_array_equal(np.asarray(bad1), np.asarray(bad2))
+        assert not np.asarray(bad2).any()  # pad +inf rows must not flag
+        np.testing.assert_allclose(np.asarray(f1), np.asarray(f2),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_streamed_step_compact_with_row_padding(monkeypatch):
+    """Compact streamed round where nb is NOT a sublane multiple: the
+    pre-padded +inf rows must be invisible (parity vs chunked)."""
+    import functools
+
+    from blades_tpu import parallel
+    from blades_tpu.adversaries import get_adversary, make_malicious_mask
+    from blades_tpu.core import FedRound, Server, TaskSpec
+    from blades_tpu.ops import pallas_round
+    from blades_tpu.ops import pallas_select
+
+    monkeypatch.setattr(pallas_round, "should_use", lambda n, d: True)
+    monkeypatch.setattr(pallas_select, "kernel_applicable",
+                        lambda n, d: True)
+    monkeypatch.setattr(
+        pallas_round, "fused_finish_compact",
+        functools.partial(pallas_round.fused_finish_compact.__wrapped__,
+                          interpret=True),
+    )
+
+    n, f = 16, 4  # nb = 12 -> padded to 16 rows
+    task = TaskSpec(model="mlp", input_shape=(8, 8, 1), num_classes=10,
+                    lr=0.1).build()
+    server = Server.from_config(aggregator="Median", lr=0.5)
+    adv = get_adversary("ALIE", num_clients=n, num_byzantine=f)
+    fr = FedRound(task=task, server=server, adversary=adv, batch_size=4,
+                  num_batches_per_round=1)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(n, 8, 8, 8, 1)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 10, size=(n, 8)), jnp.int32)
+    lengths = jnp.full((n,), 8, jnp.int32)
+    mal = make_malicious_mask(n, f)
+    key = jax.random.PRNGKey(3)
+
+    state0 = fr.init(jax.random.PRNGKey(0), n)
+    step_compact = parallel.streamed.streamed_step(
+        fr, client_block=4, update_dtype=jnp.float32, donate=False,
+        malicious_prefix=f)
+    s1, m1 = step_compact(state0, x, y, lengths, mal, key)
+
+    monkeypatch.setattr(pallas_round, "should_use", lambda n, d: False)
+    monkeypatch.setattr(pallas_select, "kernel_applicable",
+                        lambda n, d: False)
     state0 = fr.init(jax.random.PRNGKey(0), n)
     step_chunked = parallel.streamed.streamed_step(
         fr, client_block=4, update_dtype=jnp.float32, donate=False)
