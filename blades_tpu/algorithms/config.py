@@ -358,6 +358,26 @@ class FedavgConfig:
             cbs.append(ClippingCallback(float(self.clip_gradient_norm)))
         return tuple(cbs)
 
+    def resolve_augment_for_data(self, fed_round, dataset):
+        """'auto' augmentation means "the dataset's canonical train
+        transforms" (cifar crop+flip).  The SYNTHETIC fallback is not an
+        image distribution — random crops of its Gaussian class patterns
+        destroy the signal (measured: benign CIFAR ResNet accuracy
+        0.93 -> 0.19) — so auto resolves to none there.  An explicit
+        augment= request is honored as given.  Shared by every driver
+        that builds a FedRound and then loads data (Fedavg._setup, the
+        lane sweeps) — the dataset's synthetic flag is only known after
+        loading, which is why this cannot live in get_task_spec().
+        """
+        if not (getattr(dataset, "synthetic", False)
+                and self.augment == "auto"):
+            return fed_round
+        import dataclasses as _dc
+
+        task = fed_round.task
+        task = _dc.replace(task, spec=_dc.replace(task.spec, augment=None))
+        return _dc.replace(fed_round, task=task)
+
     def get_fed_round(self) -> FedRound:
         return FedRound(
             task=self.get_task_spec().build(),

@@ -46,7 +46,8 @@ class Fedavg:
             cfg.dataset, num_clients=cfg.num_clients, iid=cfg.iid,
             alpha=cfg.dirichlet_alpha, seed=cfg.seed,
         )
-        self.fed_round: FedRound = cfg.get_fed_round()
+        self.fed_round: FedRound = cfg.resolve_augment_for_data(
+            cfg.get_fed_round(), self.dataset)
         if getattr(self.fed_round.server.aggregator, "expects_trusted_row", False):
             self.fed_round = self._attach_root_data(self.fed_round)
         self.malicious = make_malicious_mask(cfg.num_clients,

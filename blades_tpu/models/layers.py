@@ -1,4 +1,4 @@
-"""Shared layers: stateless batch normalisation + client-grouped compute.
+"""Shared layers: stateless batch normalisation.
 
 The reference pins ``track_running_stats=False`` on every BatchNorm
 (ref: fllib/models/cifar10/resnet_cifar.py:10-18) so that federated weight
@@ -7,153 +7,19 @@ semantics is *simpler* than the stateful default: normalise by the current
 batch's statistics, carry no state at all.  This keeps model application a
 pure function ``(params, x) -> logits`` — which is what lets per-client
 models be a stacked-params ``vmap``.
-
-Client-grouped mode (the FedSGD fast path)
-------------------------------------------
-
-``vmap``-ing a local SGD step over clients makes every conv a
-batch-grouped conv and pushes XLA into split activation layouts —
-profiled at ~2x the cost of the same math on one merged batch (see
-:mod:`blades_tpu.core.fedsgd`).  When every client starts the step from
-the SAME global params (``num_batches_per_round == 1``, the reference's
-default, ref: fllib/algorithms/algorithm_config.py:63), the forward and
-the data-gradient backward are client-independent and can run on one
-merged ``(G*B, ...)`` batch with shared weights.  Only two things are
-per-client:
-
-- normalisation statistics — handled here by computing mean/var per
-  client-group of ``B`` consecutive samples, and
-- weight gradients — handled by *phantom parameters*: every layer output
-  is ``f(x, stop_grad(w)) + phantom(x, pw)`` where ``pw`` is a per-client
-  zero tensor and ``phantom`` is a custom-vjp function that returns zeros
-  in the forward pass (the layer is linear in its weights, and ``pw == 0``)
-  but whose weight cotangent is the *per-client* weight gradient.  The
-  phantom forward is dead code XLA removes; the backward adds exactly one
-  batch-grouped weight-grad contraction per layer — the only part of the
-  step that is irreducibly per-client.
-
-Layers enter grouped mode when called under :func:`client_grouped`; the
-phantom tensors arrive through a ``"phantoms"`` flax collection whose
-tree mirrors ``params`` with a leading group axis.  The classes are named
-``Conv``/``Dense`` so flax module paths (and therefore param trees and
-init draws) stay identical to ``nn.Conv``/``nn.Dense``.
-
-IMPORTANT CONTRACT: phantom values must be zero.  The custom vjps return
-zero input-cotangents (``d out / d x = pw = 0``); nonzero phantoms would
-make the gradients silently wrong.
 """
 
 from __future__ import annotations
 
-import contextlib
-import contextvars
 from functools import partial
-from typing import Any, Optional, Sequence, Tuple, Union
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
 from jax import lax
 
-_CLIENT_GROUPS: contextvars.ContextVar[Optional[int]] = contextvars.ContextVar(
-    "blades_tpu_client_groups", default=None
-)
-
-
-def current_groups() -> Optional[int]:
-    """Number of client groups in the active grouped context, or None."""
-    return _CLIENT_GROUPS.get()
-
-
-@contextlib.contextmanager
-def client_grouped(groups: int):
-    """Trace model application in client-grouped mode: the batch axis is
-    ``G`` client blocks of ``B`` consecutive samples."""
-    tok = _CLIENT_GROUPS.set(int(groups))
-    try:
-        yield
-    finally:
-        _CLIENT_GROUPS.reset(tok)
-
-
 # --------------------------------------------------------------------------
-# Phantom custom-vjp primitives (zero forward, per-client weight cotangent)
-# --------------------------------------------------------------------------
-
-
-@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
-def _phantom_conv(x, pw, strides, padding, out_shape, pw_meta):
-    del pw, strides, padding, pw_meta
-    return jnp.zeros(out_shape, x.dtype)
-
-
-def _phantom_conv_fwd(x, pw, strides, padding, out_shape, pw_meta):
-    del pw
-    return jnp.zeros(out_shape, x.dtype), x
-
-
-def _phantom_conv_bwd(strides, padding, out_shape, pw_meta, res, dy):
-    del out_shape
-    x = res
-    pw_shape, pw_dtype = pw_meta[0], jnp.dtype(pw_meta[1])
-    g = pw_shape[0]
-    b = x.shape[0] // g
-    xg = x.reshape((g, b) + x.shape[1:])
-    dyg = dy.reshape((g, b) + dy.shape[1:])
-
-    def one_client_dw(xc, dyc):
-        # d/dw of <conv(x, w), dy> — the exact weight-grad conv XLA builds
-        # for the vmapped path, but batched over the group axis only.
-        def inner(w):
-            y = lax.conv_general_dilated(
-                xc, w, strides, padding,
-                dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            )
-            return (y * dyc.astype(y.dtype)).sum()
-
-        return jax.grad(inner)(jnp.zeros(pw_shape[1:], pw_dtype))
-
-    dpw = jax.vmap(one_client_dw)(xg, dyg)
-    return jnp.zeros_like(x), dpw
-
-
-_phantom_conv.defvjp(_phantom_conv_fwd, _phantom_conv_bwd)
-
-
-@partial(jax.custom_vjp, nondiff_argnums=(2,))
-def _phantom_dense(x, pw, meta):
-    del meta
-    return jnp.zeros(x.shape[:-1] + (pw.shape[-1],), x.dtype)
-
-
-def _phantom_dense_fwd(x, pw, meta):
-    return jnp.zeros(x.shape[:-1] + (pw.shape[-1],), x.dtype), x
-
-
-def _phantom_dense_bwd(meta, res, dy):
-    x = res
-    pw_shape, dtype_name = meta
-    pw_dtype = jnp.dtype(dtype_name)
-    g = pw_shape[0]
-    # Fold any extra middle dims (e.g. sequence axes) into the per-client
-    # contraction axis, keeping features last — matches nn.Dense, whose
-    # kernel contracts only the trailing axis.
-    xg = x.reshape(g, -1, x.shape[-1])
-    dyg = dy.reshape(g, -1, dy.shape[-1])
-    dpw = jnp.einsum("gbi,gbo->gio", xg, dyg.astype(xg.dtype),
-                     preferred_element_type=jnp.float32).astype(pw_dtype)
-    return jnp.zeros_like(x), dpw
-
-
-_phantom_dense.defvjp(_phantom_dense_fwd, _phantom_dense_bwd)
-
-
-def _sg(x):
-    return lax.stop_gradient(x)
-
-
-# --------------------------------------------------------------------------
-# Hand-written batch-stats-norm VJP (ungrouped path)
+# Hand-written batch-stats-norm VJP
 # --------------------------------------------------------------------------
 #
 # Autodiff of the naive mean/var formulation leaves XLA with five
@@ -163,6 +29,23 @@ def _sg(x):
 # (artifacts/perf_r4/time_bn.py).  Stats accumulate in f32 with a
 # two-pass centered variance (robust for any |mean|/std the activations
 # reach); the backward is where the win lives.
+
+
+def _bn_normalize(x, axes, eps, keepdims=False):
+    """f32 stats + normalize shared by every BatchStatsNorm branch.
+    Two-pass CENTERED variance: the one-pass E[x^2] - mean^2 form loses
+    the variance entirely to f32 rounding when |mean|/std > ~2^12, which
+    f32 activations can hit.
+
+    Returns ``(xhat, mean, r)`` with mean/r cast to ``x.dtype``.
+    """
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=keepdims)
+    var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=keepdims)
+    r = lax.rsqrt(var + eps)
+    mean = mean.astype(x.dtype)
+    r = r.astype(x.dtype)
+    return (x - mean) * r, mean, r
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(3,))
@@ -202,168 +85,8 @@ def _bn_apply_bwd(eps, res, dy):
 _bn_apply.defvjp(_bn_apply_fwd, _bn_apply_bwd)
 
 
-def _bn_normalize(x, axes, eps, keepdims=False):
-    """f32 stats + normalize shared by every branch that must numerically
-    match :func:`_bn_apply` (the grouped path uses it under plain
-    autodiff).  Two-pass CENTERED variance: the one-pass E[x^2] - mean^2
-    form loses the variance entirely to f32 rounding when
-    |mean|/std > ~2^12, which f32 activations can hit.
-
-    Returns ``(xhat, mean, r)`` with mean/r cast to ``x.dtype``.
-    """
-    xf = x.astype(jnp.float32)
-    mean = jnp.mean(xf, axis=axes, keepdims=keepdims)
-    var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=keepdims)
-    r = lax.rsqrt(var + eps)
-    mean = mean.astype(x.dtype)
-    r = r.astype(x.dtype)
-    return (x - mean) * r, mean, r
-
-
-def _grouped_affine(vec, phantom, groups, ndim):
-    """Per-client channel vector ``stop_grad(vec) + phantom`` broadcast to a
-    ``(G, ...)``-grouped activation of rank ``ndim`` (including the group
-    axis).  Pure autodiff — gradients w.r.t. ``phantom`` are per-client
-    channel reductions XLA fuses natively (no custom vjp, so no forced
-    residual materialisation)."""
-    eff = _sg(vec)[None, :].astype(phantom.dtype) + phantom
-    return eff.reshape((groups,) + (1,) * (ndim - 2) + (vec.shape[-1],))
-
-
-# --------------------------------------------------------------------------
-# Group-aware drop-in layers (flax paths match nn.Conv / nn.Dense)
-# --------------------------------------------------------------------------
-
-
-def _norm_padding(padding, kernel_size):
-    if isinstance(padding, str):
-        return padding
-    if isinstance(padding, int):
-        return tuple((padding, padding) for _ in kernel_size)
-    out = []
-    for p in padding:
-        out.append((p, p) if isinstance(p, int) else tuple(p))
-    return tuple(out)
-
-
-class Conv(nn.Module):
-    """Drop-in for ``nn.Conv`` (NHWC/HWIO) with client-grouped support.
-
-    Same param names/shapes/initialisers as ``nn.Conv`` so module paths,
-    init draws and checkpoints are interchangeable.
-    """
-
-    features: int
-    kernel_size: Sequence[int]
-    strides: Union[int, Sequence[int]] = 1
-    padding: Any = "SAME"
-    use_bias: bool = True
-
-    @nn.compact
-    def __call__(self, x):
-        ks = tuple(self.kernel_size)
-        strides = (
-            (self.strides,) * len(ks)
-            if isinstance(self.strides, int)
-            else tuple(self.strides)
-        )
-        padding = _norm_padding(self.padding, ks)
-        kernel = self.param(
-            "kernel",
-            nn.initializers.lecun_normal(),
-            ks + (x.shape[-1], self.features),
-        )
-        bias = (
-            self.param("bias", nn.initializers.zeros, (self.features,))
-            if self.use_bias
-            else None
-        )
-        dt = jnp.promote_types(x.dtype, kernel.dtype)
-        x = x.astype(dt)
-        kernel = kernel.astype(dt)
-        groups = current_groups()
-        if groups is None:
-            y = lax.conv_general_dilated(
-                x, kernel, strides, padding,
-                dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            )
-            if bias is not None:
-                y = y + bias.astype(dt)
-            return y
-        # Grouped: shared-weight conv (stop-grad) + per-client phantoms.
-        pw = _get_phantom(self, "kernel", dt)
-        y = lax.conv_general_dilated(
-            x, _sg(kernel), strides, padding,
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        )
-        y = y + _phantom_conv(_sg(x), pw, strides, padding, tuple(y.shape),
-                              (tuple(pw.shape), pw.dtype.name))
-        if bias is not None:
-            pb = _get_phantom(self, "bias", dt)
-            b = y.shape[0] // groups
-            yr = y.reshape((groups, b) + y.shape[1:])
-            yr = yr + _grouped_affine(bias.astype(dt), pb, groups, yr.ndim)
-            y = yr.reshape(y.shape)
-        return y
-
-
-class Dense(nn.Module):
-    """Drop-in for ``nn.Dense`` with client-grouped support."""
-
-    features: int
-    use_bias: bool = True
-
-    @nn.compact
-    def __call__(self, x):
-        kernel = self.param(
-            "kernel", nn.initializers.lecun_normal(), (x.shape[-1], self.features)
-        )
-        bias = (
-            self.param("bias", nn.initializers.zeros, (self.features,))
-            if self.use_bias
-            else None
-        )
-        dt = jnp.promote_types(x.dtype, kernel.dtype)
-        x = x.astype(dt)
-        groups = current_groups()
-        if groups is None:
-            y = x @ kernel.astype(dt)
-            if bias is not None:
-                y = y + bias.astype(dt)
-            return y
-        pw = _get_phantom(self, "kernel", dt)
-        y = x @ _sg(kernel.astype(dt))
-        y = y + _phantom_dense(_sg(x), pw, (tuple(pw.shape), pw.dtype.name))
-        if bias is not None:
-            pb = _get_phantom(self, "bias", dt)
-            b = y.shape[0] // groups
-            yr = y.reshape((groups, b) + y.shape[1:])
-            yr = yr + _grouped_affine(bias.astype(dt), pb, groups, yr.ndim)
-            y = yr.reshape(y.shape)
-        return y
-
-
-def _get_phantom(mod: nn.Module, name: str, dt) -> jax.Array:
-    """Fetch this layer's phantom tensor from the ``phantoms`` collection
-    (provided by :mod:`blades_tpu.core.fedsgd`; mirrors the param tree
-    with a leading group axis)."""
-    if not mod.has_variable("phantoms", name):
-        raise ValueError(
-            "client-grouped mode needs a 'phantoms' collection mirroring "
-            f"params (missing {name!r} under {mod.name!r}); build it with "
-            "blades_tpu.core.fedsgd.make_phantoms"
-        )
-    v = mod.get_variable("phantoms", name)
-    return v.astype(dt)
-
-
 class BatchStatsNorm(nn.Module):
-    """Batch-statistics-only normalisation with learned scale/bias.
-
-    In client-grouped mode the statistics are per client group (each
-    group's ``B`` consecutive samples), matching what ``vmap`` over
-    clients computes, and scale/bias gradients flow through phantoms.
-    """
+    """Batch-statistics-only normalisation with learned scale/bias."""
 
     epsilon: float = 1e-5
     use_scale: bool = True
@@ -371,6 +94,8 @@ class BatchStatsNorm(nn.Module):
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        import os
+
         features = x.shape[-1]
         scale = (
             self.param("scale", nn.initializers.ones, (features,))
@@ -382,51 +107,23 @@ class BatchStatsNorm(nn.Module):
             if self.use_bias
             else None
         )
-        import os
-
-        # Escape hatch to the pre-r4 two-pass jnp.mean/jnp.var stats.
-        # Read at TRACE time: flipping it after a jitted program compiled
-        # has no effect on that program — set it before the first forward
-        # (fresh process), like BLADES_TPU_NO_PALLAS.  Governs BOTH the
-        # ungrouped and the grouped branch, so the FedSGD equivalence
-        # (grouped vs vmapped stats bit-matching) holds in either mode.
+        # Escape hatch to the pre-r4 two-pass jnp.mean/jnp.var autodiff
+        # formulation.  Read at TRACE time: flipping it after a jitted
+        # program compiled has no effect on that program — set it before
+        # the first forward (fresh process), like BLADES_TPU_NO_PALLAS.
         hand_vjp = os.environ.get("BLADES_TPU_BN_VJP", "1") != "0"
-        groups = current_groups()
-        if groups is None:
-            if scale is not None and bias is not None and hand_vjp:
-                return _bn_apply(x, scale.astype(x.dtype),
-                                 bias.astype(x.dtype), self.epsilon)
-            axes = tuple(range(x.ndim - 1))
-            if hand_vjp:  # use_scale/use_bias off: stats formula still
-                y = _bn_normalize(x, axes, self.epsilon)[0]  # matches _bn_apply
-            else:
-                mean = jnp.mean(x, axis=axes)
-                var = jnp.var(x, axis=axes)
-                y = (x - mean) * jax.lax.rsqrt(var + self.epsilon)
-            if scale is not None:
-                y = y * scale
-            if bias is not None:
-                y = y + bias
-            return y
-        g = groups
-        b = x.shape[0] // g
-        xr = x.reshape((g, b) + x.shape[1:])
-        axes = tuple(range(1, xr.ndim - 1))
-        if hand_vjp:
-            # Same f32 stats formula as _bn_apply_fwd — the FedSGD
-            # equivalence tests compare this path against the vmapped one
-            # at tight tolerance, so the stat numerics must match exactly.
-            yr = _bn_normalize(xr, axes, self.epsilon, keepdims=True)[0]
+        if scale is not None and bias is not None and hand_vjp:
+            return _bn_apply(x, scale.astype(x.dtype),
+                             bias.astype(x.dtype), self.epsilon)
+        axes = tuple(range(x.ndim - 1))
+        if hand_vjp:  # use_scale/use_bias off: stats formula still
+            y = _bn_normalize(x, axes, self.epsilon)[0]  # matches _bn_apply
         else:
-            mean = jnp.mean(xr, axis=axes, keepdims=True)
-            var = jnp.var(xr, axis=axes, keepdims=True)
-            yr = (xr - mean) * jax.lax.rsqrt(var + self.epsilon)
-        # Per-client affine via broadcast phantom params — plain autodiff,
-        # so dscale_c / dbias_c are ordinary fused channel reductions.
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+            y = (x - mean) * lax.rsqrt(var + self.epsilon)
         if scale is not None:
-            ps = _get_phantom(self, "scale", yr.dtype)
-            yr = yr * _grouped_affine(scale, ps, g, yr.ndim)
+            y = y * scale
         if bias is not None:
-            pb = _get_phantom(self, "bias", yr.dtype)
-            yr = yr + _grouped_affine(bias, pb, g, yr.ndim)
-        return yr.reshape(x.shape)
+            y = y + bias
+        return y

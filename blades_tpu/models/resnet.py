@@ -13,8 +13,9 @@ from __future__ import annotations
 from typing import Sequence, Type
 
 import flax.linen as nn
+from flax.linen import Conv, Dense
 
-from blades_tpu.models.layers import BatchStatsNorm, Conv, Dense
+from blades_tpu.models.layers import BatchStatsNorm
 
 
 class BasicBlock(nn.Module):
@@ -63,11 +64,6 @@ class ResNet(nn.Module):
     block: Type[nn.Module]
     stage_sizes: Sequence[int]
     num_classes: int = 10
-
-    # No dropout/stochastic depth and every parametric layer is
-    # group-aware (layers.Conv/Dense/BatchStatsNorm), so the FedSGD
-    # merged-batch fast path (core/fedsgd.py) is exact for this family.
-    grouped_safe: bool = True
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):

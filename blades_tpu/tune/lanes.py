@@ -160,10 +160,12 @@ def run_lanes(
         )
         return ds
 
+    first_ds = None
     if per_seed_data:
         stacks = {k: [] for k in ("x", "y", "ln", "tx", "ty", "tln")}
         for s in seeds:
             ds = load(s)
+            first_ds = first_ds or ds
             stacks["x"].append(ds.train.x)
             stacks["y"].append(ds.train.y)
             stacks["ln"].append(ds.train.lengths)
@@ -187,11 +189,15 @@ def run_lanes(
         dax = 0
     else:
         ds = load(seeds[0])
+        first_ds = ds
         x, y, ln = (jnp.asarray(ds.train.x), jnp.asarray(ds.train.y),
                     jnp.asarray(ds.train.lengths))
         tx, ty, tln = (jnp.asarray(ds.test.x), jnp.asarray(ds.test.y),
                        jnp.asarray(ds.test.lengths))
         dax = None
+    # Same auto-augment resolution as Fedavg._setup: crop+flip of the
+    # synthetic fallback's Gaussian patterns destroys the signal.
+    fr = base.resolve_augment_for_data(fr, first_ds)
     mal = make_malicious_mask(base.num_clients, base.num_malicious_clients)
 
     # Lane key streams, identical to the sequential driver's.

@@ -208,9 +208,33 @@ def test_cifar_config_gets_augmentation():
     cfg = FedavgConfig().data(dataset="cifar10", num_clients=4)
     cfg.validate()
     assert cfg.get_task_spec().augment == "cifar"
+    # Dict catalog specs resolve the same way (ADVICE r3: a
+    # {"type": "cifar10", ...} spec silently disabled crop+flip).
+    cfg_d = FedavgConfig().data(
+        dataset={"type": "cifar10", "synthetic_noise": 3.0}, num_clients=4)
+    cfg_d.validate()
+    assert cfg_d.get_task_spec().augment == "cifar"
     cfg2 = FedavgConfig().data(dataset="mnist", num_clients=4)
     cfg2.validate()
     assert cfg2.get_task_spec().augment is None
+
+
+def test_auto_augment_disabled_on_synthetic_fallback():
+    """'auto' augmentation must resolve to none when the loaded data is
+    the synthetic fallback — random crops of its Gaussian class patterns
+    destroy the signal (measured 0.93 -> 0.19 benign accuracy)."""
+    from blades_tpu.algorithms import FedavgConfig
+
+    import pytest
+
+    algo = (FedavgConfig()
+            .data(dataset="cifar10", num_clients=4, seed=0)
+            .training(global_model="mlp", input_shape=(32, 32, 3),
+                      aggregator={"type": "Mean"}, server_lr=1.0)
+            .build())
+    if not algo.dataset.synthetic:
+        pytest.skip("raw CIFAR present on this machine")
+    assert algo.fed_round.task.spec.augment is None
 
 
 def test_rounds_per_dispatch_chunked_driver():
@@ -260,10 +284,11 @@ def test_streamed_execution_matches_dense():
 def test_streamed_execution_validation():
     import pytest
 
+    # rounds_per_dispatch > 1 is SUPPORTED on the streamed path since r4
+    # (streamed_multi_step chains the rounds with no host sync).
     _, cfg = get_algorithm_class("FEDAVG", return_config=True)
     cfg.update_from_dict({"execution": "streamed", "rounds_per_dispatch": 4})
-    with pytest.raises(ValueError, match="rounds_per_dispatch"):
-        cfg.validate()
+    cfg.validate()
     _, cfg = get_algorithm_class("FEDAVG", return_config=True)
     cfg.update_from_dict({"execution": "bogus"})
     with pytest.raises(ValueError, match="execution"):
