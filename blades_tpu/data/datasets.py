@@ -225,7 +225,17 @@ def _build_image_dataset(
         if tx.shape[1:] != input_shape:
             tx = tx.reshape((-1,) + input_shape)
             vx = vx.reshape((-1,) + input_shape)
-    del train_frac  # reference's train_data_frac subsetting: not used by tuned configs
+    if not (0.0 < train_frac <= 1.0):
+        raise ValueError(f"train_frac must be in (0, 1], got {train_frac}")
+    if train_frac < 1.0:
+        # Subsample the TRAIN pool before partitioning (a seeded random
+        # subset, like the reference's random dataset subsetting) — the
+        # data-scarcity dial: train on a fraction of the data, evaluate
+        # on the full test set.
+        rng = np.random.default_rng(seed ^ 0xF4AC)
+        keep = rng.choice(len(ty), size=max(1, int(len(ty) * train_frac)),
+                          replace=False)
+        tx, ty = tx[np.sort(keep)], ty[np.sort(keep)]
     train = partition_dataset(tx, ty, num_clients, iid=iid, alpha=alpha, seed=seed)
     test = partition_dataset(vx, vy, num_clients, iid=True, seed=seed + 1)
     return FLDataset(

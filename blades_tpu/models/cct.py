@@ -242,3 +242,107 @@ cct_7_3x1_32 = VARIANTS["cct_7_3x1_32"]
 cvt_7_4_32 = VARIANTS["cvt_7_4_32"]
 
 
+
+
+# ---------------------------------------------------------------------------
+# Pretrained-weight import (ref: fllib/models/backbones/cctnets/utils/
+# helpers.py — pe_check/resize_pos_embed + fc_check over torch state dicts).
+# TPU-native form: flax param trees from LOCAL .npz / .msgpack files (this
+# environment has no egress; the reference pulls torch checkpoints by URL).
+# ---------------------------------------------------------------------------
+
+
+def load_pretrained_params(params, path, *, resize_pos_embed=True,
+                           skip_mismatched_head=True):
+    """Merge a saved CCT/CVT param tree into ``params``.
+
+    - ``.npz``: flat ``{"a/b/c": array}`` mapping (as written by
+      :func:`save_params`); ``.msgpack``: flax binary serialization of
+      the full tree.
+    - A ``pos_embed`` leaf whose sequence length differs is bilinearly
+      resized over the token grid (the reference's ``resize_pos_embed``,
+      adapted from the ViT checkpoint loader) when ``resize_pos_embed``.
+    - Mismatched classifier-head leaves keep their fresh initialization
+      when ``skip_mismatched_head`` (the reference's ``fc_check`` path
+      for transfer to a different class count); any OTHER shape mismatch
+      raises.
+
+    Returns the merged tree (same structure/dtypes as ``params``).
+    """
+    import math
+    from pathlib import Path
+
+    import numpy as np
+
+    p = Path(path)
+    if p.suffix == ".npz":
+        with np.load(p) as z:
+            flat_src = {k: z[k] for k in z.files}
+    elif p.suffix == ".msgpack":
+        from flax import serialization
+
+        tree = serialization.msgpack_restore(p.read_bytes())
+        flat_src = {"/".join(k): v
+                    for k, v in traverse_util.flatten_dict(tree).items()}
+    else:
+        raise ValueError(f"unsupported checkpoint format: {p.suffix!r} "
+                         "(use .npz or .msgpack)")
+
+    from flax import traverse_util
+
+    flat_dst = traverse_util.flatten_dict(params)
+    out = {}
+    matched = 0
+    skipped = []
+    for key, dst in flat_dst.items():
+        name = "/".join(key)
+        if name not in flat_src:
+            skipped.append(name)
+            out[key] = dst  # e.g. head of a different variant: keep init
+            continue
+        src = jnp.asarray(flat_src[name])
+        if src.shape == dst.shape:
+            out[key] = src.astype(dst.dtype)
+            matched += 1
+            continue
+        if (resize_pos_embed and key[-1] == "pos_embed"
+                and src.shape[-1] == dst.shape[-1]):
+            # (1, seq, dim) -> bilinear over the sqrt(seq) token grid.
+            g_old = int(math.sqrt(src.shape[1]))
+            g_new = int(math.sqrt(dst.shape[1]))
+            if g_old * g_old != src.shape[1] or g_new * g_new != dst.shape[1]:
+                raise ValueError(
+                    f"cannot resize pos_embed {src.shape} -> {dst.shape}: "
+                    "non-square token grids")
+            grid = src.reshape(g_old, g_old, src.shape[-1])
+            grid = jax.image.resize(
+                grid, (g_new, g_new, src.shape[-1]), method="bilinear")
+            out[key] = grid.reshape(1, g_new * g_new,
+                                    src.shape[-1]).astype(dst.dtype)
+            matched += 1
+            continue
+        if skip_mismatched_head and key[-1] in ("kernel", "bias") and (
+                src.shape[-1] != dst.shape[-1]):
+            skipped.append(name)
+            out[key] = dst  # different class count: fresh head
+            continue
+        raise ValueError(
+            f"shape mismatch for {name}: checkpoint {src.shape} vs "
+            f"model {dst.shape}")
+    if matched == 0:
+        raise ValueError(
+            f"checkpoint {p} matched NO parameter of the target model "
+            f"({len(flat_dst)} leaves; first unmatched: {skipped[:3]}) — "
+            "wrong model family or naming scheme")
+    return traverse_util.unflatten_dict(out)
+
+
+def save_params(params, path):
+    """Write a param tree as a flat .npz (the format
+    :func:`load_pretrained_params` reads)."""
+    import numpy as np
+    from flax import traverse_util
+
+    flat = {"/".join(k): np.asarray(v)
+            for k, v in traverse_util.flatten_dict(params).items()}
+    np.savez(path, **flat)

@@ -194,7 +194,7 @@ def _lanes_eligible(spec_run: str, trial: Dict, group: List[int]) -> bool:
                       f"{type(exc).__name__}: {exc}", RuntimeWarning)
         return False
     lane_bytes = len(group) * cfg.num_clients * d * 4
-    return lane_bytes <= Fedavg._DENSE_MATRIX_HBM_LIMIT
+    return lane_bytes <= Fedavg.dense_matrix_hbm_limit()
 
 
 # ---------------------------------------------------------------------------

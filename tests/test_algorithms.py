@@ -343,3 +343,32 @@ def test_dsharded_execution_requires_mesh():
     cfg.update_from_dict({"execution": "dsharded"})
     with pytest.raises(ValueError, match="num_devices"):
         cfg.validate()
+
+
+def test_dense_matrix_hbm_limit_is_device_derived(monkeypatch):
+    """'auto' execution's dense budget: env override > device
+    memory_stats > the 16 GB-chip fallback (VERDICT r3 item 7)."""
+    from blades_tpu.algorithms.fedavg import Fedavg
+
+    class FakeDev:
+        def __init__(self, stats):
+            self._stats = stats
+
+        def memory_stats(self):
+            return self._stats
+
+    # The override knob must not leak in from the ambient environment.
+    monkeypatch.delenv("BLADES_TPU_DENSE_MATRIX_LIMIT_GB", raising=False)
+
+    # Device reports 95 GB (e.g. a v4p/v5p-class chip): the budget scales.
+    monkeypatch.setattr(
+        jax, "devices", lambda *a: [FakeDev({"bytes_limit": 95 * (1 << 30)})])
+    assert Fedavg.dense_matrix_hbm_limit() == int(95 * (1 << 30) * 3 / 8)
+
+    # No stats (CPU / remote relay): the tuned 6 GB fallback.
+    monkeypatch.setattr(jax, "devices", lambda *a: [FakeDev(None)])
+    assert Fedavg.dense_matrix_hbm_limit() == 6 * (1 << 30)
+
+    # Env override wins over everything.
+    monkeypatch.setenv("BLADES_TPU_DENSE_MATRIX_LIMIT_GB", "2.5")
+    assert Fedavg.dense_matrix_hbm_limit() == int(2.5 * (1 << 30))

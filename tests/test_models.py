@@ -142,3 +142,51 @@ def test_catalog_resolves_named_cct_variants():
     x = jnp.zeros((1, 32, 32, 3))
     params = m.init(jax.random.PRNGKey(0), x)
     assert m.apply(params, x).shape == (1, 7)
+
+
+def test_cct_pretrained_weight_import(tmp_path):
+    """The reference's pretrained-checkpoint hooks (pe_check /
+    resize_pos_embed / fc_check, cctnets/utils/helpers.py) in flax form:
+    exact round-trip, positional-embedding grid resize, and
+    fresh-head transfer to a different class count."""
+    import numpy as np
+
+    from blades_tpu.models.cct import (cct_2_3x2_32, load_pretrained_params,
+                                       save_params)
+
+    m = cct_2_3x2_32()
+    params = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))["params"]
+    ckpt = tmp_path / "cct.npz"
+    save_params(params, ckpt)
+
+    # Exact round-trip.
+    loaded = load_pretrained_params(params, ckpt)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(loaded)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # Different image size -> pos_embed token grid resized, not rejected.
+    m48 = cct_2_3x2_32().clone(img_size=48)
+    p48 = m48.init(jax.random.PRNGKey(1), jnp.zeros((1, 48, 48, 3)))["params"]
+    merged = load_pretrained_params(p48, ckpt)
+    out = m48.apply({"params": merged}, jnp.zeros((2, 48, 48, 3)))
+    assert out.shape == (2, 10)
+
+    # Different class count -> head keeps its fresh init, body loads.
+    m100 = cct_2_3x2_32(num_classes=100)
+    p100 = m100.init(jax.random.PRNGKey(2),
+                     jnp.zeros((1, 32, 32, 3)))["params"]
+    merged = load_pretrained_params(p100, ckpt)
+    out = m100.apply({"params": merged}, jnp.zeros((2, 32, 32, 3)))
+    assert out.shape == (2, 100)
+
+    # A checkpoint from a different model family matches nothing and
+    # must fail loudly instead of silently returning fresh init.
+    import pytest
+
+    from blades_tpu.models import MLP
+    mlp = MLP()
+    mp = mlp.init(jax.random.PRNGKey(3), jnp.zeros((1, 28, 28, 1)))["params"]
+    wrong = tmp_path / "mlp.npz"
+    save_params(mp, wrong)
+    with pytest.raises(ValueError, match="matched NO parameter"):
+        load_pretrained_params(params, wrong)

@@ -123,3 +123,24 @@ def test_dirichlet_partition_impossible_raises():
     y = np.zeros(50, dtype=int)
     with pytest.raises(ValueError, match="min_size"):
         dirichlet_partition(y, 10, alpha=0.1, seed=0)
+
+
+def test_train_frac_subsamples_train_pool():
+    """train_frac subsets the TRAIN pool before partitioning (the
+    reference's dataset-subsetting dial); test data stays full."""
+    from blades_tpu.data import DatasetCatalog
+
+    full = DatasetCatalog.get_dataset("mnist", num_clients=4, seed=3)
+    half = DatasetCatalog.get_dataset(
+        {"type": "mnist", "train_frac": 0.5}, num_clients=4, seed=3)
+    n_full = int(np.asarray(full.train.lengths).sum())
+    n_half = int(np.asarray(half.train.lengths).sum())
+    assert abs(n_half - n_full // 2) <= 4
+    assert (np.asarray(half.test.lengths).sum()
+            == np.asarray(full.test.lengths).sum())
+
+    import pytest
+
+    with pytest.raises(ValueError, match="train_frac"):
+        DatasetCatalog.get_dataset({"type": "mnist", "train_frac": 0.0},
+                                   num_clients=4, seed=3)
