@@ -13,12 +13,14 @@ Two measured workloads, one JSON line:
    bf16 update matrix, client-block vmapped training, and the fused
    pallas finish (forge + exact Median in ONE HBM pass,
    ops/pallas_round.py).
-2. **ResNet-18 @ 576 clients** (the model BASELINE.json actually names):
-   576 is the measured single-chip capacity limit — the bf16 update
-   matrix is 12.9 GB and n=640 is a verified compile-time OOM (16.66 GB
-   > 15.75 GB HBM); n=1000 (22.3 GB) cannot exist on one chip and is
-   the multi-chip d-sharded configuration (``parallel/dsharded.py``,
-   validated on the 8-device virtual mesh).  Host-offloading the matrix
+2. **ResNet-18 @ 768 clients** (the model BASELINE.json actually names):
+   768 is the single-chip capacity limit under malicious-lane elision —
+   the benign-compacted bf16 update matrix stores 576 rows = 12.9 GB
+   (the full-matrix limit through round 3 was n=576; n=640 full was a
+   verified compile-time OOM at 16.66 GB > 15.75 GB HBM); n=1000
+   (22.3 GB full) cannot exist on one chip and is the multi-chip
+   d-sharded configuration (``parallel/dsharded.py``, validated on the
+   8-device virtual mesh).  Host-offloading the matrix
    was measured infeasible in THIS environment: the accelerator relay
    moves ~10-20 MB/s host<->device, so a 22 GB round trip would take
    >30 min/round (on directly-attached hardware PCIe would make that
@@ -133,6 +135,22 @@ def bench_workload(model: str, num_clients: int, client_block: int,
                          malicious_prefix=num_byzantine)
     d = sum(p.size for p in jax.tree.leaves(state.server.params))
 
+    # This benchmark's capacity claims assume the benign-COMPACTED
+    # matrix (the n=768 ResNet-18 config only fits HBM that way).
+    # Verify the gate that streamed_step will apply actually engages —
+    # a silent fallback to the full matrix would OOM r18 and misreport
+    # the stored size.
+    from blades_tpu.ops.pallas_select import kernel_applicable
+
+    compacted = (kernel_applicable(num_clients - num_byzantine, d)
+                 and num_byzantine % client_block == 0)
+    if not compacted:
+        raise RuntimeError(
+            "benign-compacted streamed path not engaged (non-TPU backend "
+            "or BLADES_TPU_NO_PALLAS=1?) — this benchmark's configs "
+            "assume it; run on TPU with the pallas kernels enabled"
+        )
+
     flops_client = _flops_per_client_round(fr, state.server.params)
     flops_src = "xla_cost_analysis"
     if not flops_client:
@@ -169,7 +187,10 @@ def bench_workload(model: str, num_clients: int, client_block: int,
         "byzantine": num_byzantine,
         "model": model,
         "params": d,
-        "update_matrix_gb": round(num_clients * d * 2 / 1e9, 1),
+        # STORED matrix: benign rows only (elision compacts the
+        # byzantine quarter away).
+        "update_matrix_gb": round((num_clients - num_byzantine) * d * 2 / 1e9,
+                                  1),
         "malicious_training": "elided (ALIE replaces forged rows from "
                               "benign stats; see streamed_step docstring)",
     }
@@ -201,22 +222,27 @@ def main() -> None:
     }
 
     if os.environ.get("BLADES_BENCH_RESNET18", "1") == "1":
-        # client_block 16 (was 32): the r4 hand-written BN VJP costs
-        # ~0.2 GB of temp HBM at this capacity-edge scale; halving the
-        # block's activation footprint keeps n=576 compiling, at ~1% in
-        # extra dispatch overhead.
-        r18 = bench_workload("resnet18", 576, 16, timed_rounds=3)
-        rps8 = round(r18["rounds_per_sec"] * 576 * 8 / 1000 * 0.7, 2)
+        # n=768 (was 576 through round 3): malicious-lane elision stores
+        # only the 576 benign rows of the bf16 update matrix (12.9 GB) —
+        # the byzantine quarter's rows never exist — so the single-chip
+        # capacity grew by exactly the attack fraction.  client_block 16
+        # keeps the training block's activation temps (~1.9 GB) inside
+        # the remaining headroom.
+        r18 = bench_workload("resnet18", 768, 16, timed_rounds=3)
+        rps8 = round(r18["rounds_per_sec"] * 768 * 8 / 1000 * 0.7, 2)
         r18["note"] = (
-            "576 is the measured single-chip limit: n=640 is a verified "
-            "compile OOM (16.66 > 15.75 GB HBM); n=1000 (22.3 GB bf16) is "
-            "the multi-chip d-sharded config (parallel/dsharded.py). "
-            "Host-offload is infeasible here: relay moves 10-20 MB/s."
+            "768 is the single-chip limit under malicious-lane elision "
+            "(the compacted matrix stores only the 576 benign rows = "
+            "12.9 GB; through r3 the full-matrix limit was n=576, with "
+            "n=640 a verified compile OOM at 16.66 > 15.75 GB HBM). "
+            "n=1000 (22.3 GB bf16 full) remains the multi-chip d-sharded "
+            "config (parallel/dsharded.py). Host-offload is infeasible "
+            "here: the relay moves 10-20 MB/s."
         )
         r18["projection_1000clients_v5e8"] = {
             "rounds_per_sec": rps8,
             "kind": "estimate",
-            "formula": "measured_576 x (576*8/1000 client-throughput "
+            "formula": "measured_768 x (768*8/1000 client-throughput "
                        "scaling) x 0.7 collective/imbalance discount; "
                        "training is client-parallel across chips (125 "
                        "clients/chip) and the d-sharded finish passes "
