@@ -105,10 +105,10 @@ def main():
     print(f"  {'TOTAL':40s} {sum(by_cat.values()) / 1e3 / REP:8.2f} ms")
     print("== top 30 ops ==")
     for r in sorted(recs, key=lambda r: -(r["total_self_time"] or 0))[:30]:
-        expr = (r["hlo_op_expression"] or "")[:140].replace("\n", " ")
+        expr = (r["hlo_op_expression"] or "")[:110].replace("\n", " ")
         print(f"  {(r['total_self_time'] or 0) / 1e3 / REP:7.3f} ms "
               f"x{int(r['occurrences'] or 0):4d} [{r['category']}] "
-              f"{r['bound_by']}: {expr}")
+              f"{r['bound_by']} dma%={r['dma_stall_percent']}: {expr}")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,179 @@
+"""Standalone: pallas per-client BN backward vs the jnp formulation,
+stage-1 ResNet shape (G=50, B=32, 32x32, C=64), bf16.
+
+Inputs: x, dy (G,B,H,W,C) bf16; mean, r, scale (G,C) f32 (saved by the
+forward).  Outputs: dx (G,B,H,W,C) bf16; dscale, dbias (G,C) f32.
+
+Run: cd /root/repo && PYTHONPATH="$PYTHONPATH:." python artifacts/perf_r4/time_bn_pallas.py
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+G, B, H, W, C = 50, 32, 32, 32, 64
+N = B * H * W
+REP = 8
+PASSES = 6
+
+
+def jnp_bwd(x, dy, mean, r, scale):
+    """The hand-VJP formulas as XLA sees them (per client via vmap)."""
+
+    def one(x, dy, mean, r, scale):
+        xhat = (x - mean) * r
+        dyf = dy.astype(jnp.float32)
+        dbias = jnp.sum(dyf, axis=(0, 1))
+        dscale = jnp.sum(dyf * xhat.astype(jnp.float32), axis=(0, 1))
+        dxhat = dy * scale.astype(dy.dtype)
+        mean_dxhat = (jnp.sum(dxhat.astype(jnp.float32), axis=(0, 1))
+                      / N).astype(dy.dtype)
+        m2 = (dscale * scale / N).astype(dy.dtype)
+        dx = r.astype(dy.dtype) * (dxhat - mean_dxhat
+                                   - xhat * m2.astype(dy.dtype))
+        return dx, dscale, dbias
+
+    mean = mean.astype(x.dtype)[:, None, None, :]
+    r_ = r.astype(x.dtype)[:, None, None, :]
+    # one() sees (B*H, W, C); mean/r broadcast as (1, 1, C)
+    return jax.vmap(one)(
+        x.reshape(G, B * H, W, C), dy.reshape(G, B * H, W, C),
+        mean, r_, scale,
+    )
+
+
+NT = 4096  # N-tile: (4096, 64) bf16 + f32 temps fit scoped VMEM
+
+
+def _bn_reduce_kernel(x_ref, dy_ref, mean_ref, r_ref, dscale_ref,
+                      dbias_ref):
+    g, t = pl.program_id(0), pl.program_id(1)
+    x = x_ref[0]
+    dy = dy_ref[0]
+    mean = mean_ref[pl.ds(g, 1)]
+    r = r_ref[pl.ds(g, 1)]
+    xf = x.astype(jnp.float32)
+    dyf = dy.astype(jnp.float32)
+    xhat = (xf - mean) * r
+
+    @pl.when(t == 0)
+    def _init():
+        dscale_ref[pl.ds(g, 1)] = jnp.zeros((1, C), jnp.float32)
+        dbias_ref[pl.ds(g, 1)] = jnp.zeros((1, C), jnp.float32)
+
+    dbias_ref[pl.ds(g, 1)] += jnp.sum(dyf, axis=0, keepdims=True)
+    dscale_ref[pl.ds(g, 1)] += jnp.sum(dyf * xhat, axis=0, keepdims=True)
+
+
+def _bn_dx_kernel(x_ref, dy_ref, mean_ref, r_ref, scale_ref, dscale_ref,
+                  dbias_ref, dx_ref):
+    g = pl.program_id(0)
+    x = x_ref[0]
+    dy = dy_ref[0]
+    mean = mean_ref[pl.ds(g, 1)]
+    r = r_ref[pl.ds(g, 1)]
+    scale = scale_ref[pl.ds(g, 1)]
+    dscale = dscale_ref[pl.ds(g, 1)]
+    dbias = dbias_ref[pl.ds(g, 1)]
+    xf = x.astype(jnp.float32)
+    dyf = dy.astype(jnp.float32)
+    xhat = (xf - mean) * r
+    dxhat = dyf * scale
+    mean_dxhat = dbias * scale / N
+    m2 = dscale * scale / N
+    dx = r * (dxhat - mean_dxhat - xhat * m2)
+    dx_ref[0] = dx.astype(dx_ref.dtype)
+
+
+def _gc_spec():
+    return pl.BlockSpec((G, C), lambda *a: (0, 0), memory_space=pltpu.VMEM)
+
+
+def _tile_spec():
+    return pl.BlockSpec((1, NT, C), lambda g, t: (g, t, 0),
+                        memory_space=pltpu.VMEM)
+
+
+@jax.jit
+def pallas_bwd(x, dy, mean, r, scale):
+    x2 = x.reshape(G, N, C)
+    dy2 = dy.reshape(G, N, C)
+    dscale, dbias = pl.pallas_call(
+        _bn_reduce_kernel,
+        grid=(G, N // NT),
+        in_specs=[_tile_spec(), _tile_spec(), _gc_spec(), _gc_spec()],
+        out_specs=[_gc_spec(), _gc_spec()],
+        out_shape=[jax.ShapeDtypeStruct((G, C), jnp.float32),
+                   jax.ShapeDtypeStruct((G, C), jnp.float32)],
+    )(x2, dy2, mean, r)
+    dx = pl.pallas_call(
+        _bn_dx_kernel,
+        grid=(G, N // NT),
+        in_specs=[_tile_spec(), _tile_spec(), _gc_spec(), _gc_spec(),
+                  _gc_spec(), _gc_spec(), _gc_spec()],
+        out_specs=_tile_spec(),
+        out_shape=jax.ShapeDtypeStruct((G, N, C), x.dtype),
+    )(x2, dy2, mean, r, scale, dscale, dbias)
+    return dx.reshape(x.shape), dscale, dbias
+
+
+def timed(fn, args):
+    @jax.jit
+    def run(*a):
+        def body(c, _):
+            out = fn(a[0] + c.astype(a[0].dtype) * 0, *a[1:])
+            return out[1][0, 0] + out[2][0, 0], None
+
+        out, _ = lax.scan(body, jnp.float32(0.0), None, length=REP)
+        return out
+
+    return lambda: run(*args)
+
+
+def main():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(G, B, H, W, C)), jnp.bfloat16)
+    dy = jnp.asarray(rng.normal(size=(G, B, H, W, C)) * 0.1, jnp.bfloat16)
+    mean = jnp.asarray(rng.normal(size=(G, C)) * 0.1, jnp.float32)
+    r = jnp.asarray(1.0 + rng.random((G, C)), jnp.float32)
+    scale = jnp.asarray(1.0 + rng.random((G, C)) * 0.1, jnp.float32)
+
+    # Correctness first.
+    def jnp_flat(x, dy, mean, r, scale):
+        dx, ds, db = jnp_bwd(x, dy, mean, r, scale)
+        return dx.reshape(x.shape), ds, db
+
+    a = jnp_flat(x, dy, mean, r, scale)
+    b = pallas_bwd(x, dy, mean, r, scale)
+    for u, v, name in zip(a, b, ("dx", "dscale", "dbias")):
+        err = float(jnp.max(jnp.abs(u.astype(jnp.float32)
+                                    - v.astype(jnp.float32))))
+        print(f"# {name} maxdiff {err:.5f}")
+
+    runs = {"jnp": timed(jnp_flat, (x, dy, mean, r, scale)),
+            "pallas": timed(pallas_bwd, (x, dy, mean, r, scale))}
+    for name, run in runs.items():
+        t0 = time.perf_counter()
+        float(run())
+        print(f"# compile {name}: {time.perf_counter() - t0:.1f}s",
+              flush=True)
+    times = {k: [] for k in runs}
+    for p in range(PASSES):
+        for name, run in runs.items():
+            t0 = time.perf_counter()
+            float(run())
+            times[name].append((time.perf_counter() - t0) / REP)
+    for name, ts in times.items():
+        print(f"{name}: {min(ts) * 1e3:.2f} ms")
+
+
+if __name__ == "__main__":
+    main()
