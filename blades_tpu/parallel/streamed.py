@@ -49,8 +49,13 @@ finish (sanitize + forge + aggregate + row norms) runs as ONE fused
 pallas kernel in a single HBM pass over the stored matrix
 (:mod:`blades_tpu.ops.pallas_round`), with a 16-step radix select in
 bf16 key space when storage is bf16 — ~3.5x the chunked finish at
-n=1000 x d=4.9M.  Every other configuration falls back to the chunked
-path.
+n=1000 x d=4.9M.  When the malicious prefix is elided block-aligned
+(``malicious_prefix``), the matrix is further COMPACTED to the benign
+rows only and the forged row enters the order statistics as a virtual
+row of multiplicity f (``fused_finish_compact``) — per-row kernel work
+and matrix HBM shrink by the byzantine fraction (9.8 -> 7.4 GB at the
+benchmark scale, and ResNet-18 fits n=768 on one chip).  Every other
+configuration falls back to the chunked path.
 
 1000 clients x ResNet-10 (d=4.9M) in bf16 = 9.8 GB: fits a single 16 GB
 v5e chip with ~1 GB chunk workspace.  ResNet-18 at n=1000 (22.3 GB bf16)
