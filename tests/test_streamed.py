@@ -270,3 +270,29 @@ def test_streamed_rounds_per_dispatch_from_config():
     r = algo.train()
     assert r["training_iteration"] == 8
     assert np.isfinite(r["train_loss"])
+
+
+def test_malicious_prefix_elision_exact_under_dp(data):
+    """Elision + per-row DP: malicious lanes' clip norms differ (0 for
+    untrained rows) but are dead — the forge overwrites those rows after
+    DP.  Full vs elided must stay bit-equal at f32."""
+    x, y, ln, mal = data
+    fr = make_fr("Median", "ALIE", dp_clip_threshold=0.05,
+                 dp_noise_factor=0.5)
+    key = jax.random.PRNGKey(13)
+
+    st_a = fr.init(jax.random.PRNGKey(0), N)
+    full = streamed_step(fr, client_block=2, d_chunk=10_000,
+                         update_dtype=jnp.float32)
+    st_a, m_a = full(st_a, x, y, ln, mal, key)
+
+    st_b = fr.init(jax.random.PRNGKey(0), N)
+    elided = streamed_step(fr, client_block=2, d_chunk=10_000,
+                           update_dtype=jnp.float32, malicious_prefix=F)
+    st_b, m_b = elided(st_b, x, y, ln, mal, key)
+
+    for a, b in zip(jax.tree.leaves(st_a.server.params),
+                    jax.tree.leaves(st_b.server.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_array_equal(np.asarray(m_a["agg_norm"]),
+                                  np.asarray(m_b["agg_norm"]))
