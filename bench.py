@@ -40,15 +40,28 @@ Honest reporting (VERDICT r1):
   clients with PERFECT 4-GPU scaling (its "large" preset) ->
   0.24 rounds/s.  The estimate and its provenance ride in the JSON.
 
-Prints ONE JSON line.
+Prints ONE JSON line — ALWAYS, even when the backend is gone.  Round 4
+was lost to a flapping axon relay: ``jax.devices()`` hung ~26 min per
+probe inside the process, the retry loop ate the driver's window, and
+``BENCH_r04.json`` recorded rc=124 with no output.  The probe now runs
+in a subprocess with a hard wall-clock deadline (total budget ~5 min),
+a watchdog bounds the whole run, and every failure path emits an
+explicit ``{"error": ...}`` JSON line so the driver records a parseable
+result no matter what the relay does.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
+import threading
 import time
 
+# Importing jax does NOT initialize the backend (the round-4 hang was
+# inside jax.devices(), i.e. backend init) — the import itself is safe
+# before the subprocess probe below.
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -63,20 +76,115 @@ BASELINE_EST_ROUNDS_PER_SEC = 0.24
 V5E_BF16_PEAK_FLOPS = 197e12
 
 
-def _wait_for_backend(tries: int = 4, delay_s: float = 60.0) -> None:
-    """The axon relay tunnel can flap; give it a few minutes before
-    giving up rather than failing the graded run on the first probe."""
-    for i in range(tries):
+METRIC_NAME = ("fl_rounds_per_sec_1000clients_fedavg_alie_median_cifar10_"
+               "resnet10")
+
+# Exactly ONE JSON line, even with the watchdog thread racing the main
+# thread at the deadline: lock-protected check-and-set, and the flag
+# records whether the line that went out was a success result.
+_emit_lock = threading.Lock()
+_emitted = {"done": False, "ok": False}
+
+
+def _emit(obj: dict) -> bool:
+    """Print the line if none has gone out yet; returns whether it did."""
+    with _emit_lock:
+        if _emitted["done"]:
+            return False
+        _emitted["done"] = True
+        _emitted["ok"] = "error" not in obj
+        print(json.dumps(obj), flush=True)
+        return True
+
+
+def _error_json(stage: str, detail: str) -> dict:
+    return {
+        "metric": METRIC_NAME,
+        "value": None,
+        "unit": "rounds/s",
+        "vs_baseline": None,
+        "error": stage,
+        "detail": detail[-800:],
+    }
+
+
+def _wait_for_backend(total_budget_s: float = 300.0,
+                      probe_timeout_s: float = 75.0) -> str | None:
+    """Probe the backend in a SUBPROCESS with a hard per-probe deadline.
+
+    Round 4's lesson (VERDICT r4 weak #1): when the axon relay flaps,
+    ``jax.devices()`` doesn't raise — it HANGS (observed ~26 min per
+    probe), so an in-process try/except retry loop silently eats the
+    driver's whole window and the run ends rc=124 with no output.  The
+    only robust shape is a child process we can kill on a wall-clock
+    deadline.  Total wait is capped at ~5 minutes; on failure the caller
+    emits an explicit ``{"error": ...}`` JSON line so the driver records
+    a parseable result either way.
+
+    Returns None when the backend is reachable, else a description of
+    the last failure.
+    """
+    deadline = time.monotonic() + total_budget_s
+    last_err = "no probe ran"
+    attempt = 0
+    # sitecustomize sets jax_platforms="axon,cpu": a FAST-failing axon
+    # plugin falls back to the CPU backend, which must count as a failed
+    # probe (the bench's configs only run on TPU) — unless explicitly
+    # allowed for local testing.
+    allow_cpu = os.environ.get("BLADES_BENCH_ALLOW_CPU", "0") == "1"
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining < 5.0:
+            return last_err
+        attempt += 1
+        t = min(probe_timeout_s, remaining)
         try:
-            jax.devices()
-            return
-        except Exception as e:
-            if i == tries - 1:
-                raise
-            print(f"# backend unavailable ({type(e).__name__}), "
-                  f"retry {i + 1}/{tries - 1} in {delay_s:.0f}s",
-                  file=__import__("sys").stderr, flush=True)
-            time.sleep(delay_s)
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(jax.devices()[0].platform)"],
+                capture_output=True, text=True, timeout=t)
+            platform = r.stdout.strip()
+            if r.returncode == 0 and platform and (
+                    allow_cpu or platform.lower() != "cpu"):
+                print(f"# backend reachable: {platform} "
+                      f"(probe {attempt})", file=sys.stderr, flush=True)
+                return None
+            if r.returncode == 0 and platform:
+                last_err = (f"only the {platform} fallback backend is up "
+                            f"(axon/TPU plugin failed fast)")
+            else:
+                last_err = ((r.stderr or r.stdout).strip() or
+                            f"probe exited rc={r.returncode}")
+        except subprocess.TimeoutExpired:
+            last_err = (f"jax.devices() hung >{t:.0f}s in the probe "
+                        f"subprocess (axon relay unreachable)")
+        print(f"# backend probe {attempt} failed: {last_err[-200:]}",
+              file=sys.stderr, flush=True)
+        time.sleep(min(20.0, max(0.0, deadline - time.monotonic())))
+
+
+def _arm_watchdog(deadline_s: float) -> None:
+    """A hang AFTER the probe (relay dying mid-compile) must still
+    produce the one JSON line: emit an error and hard-exit at the
+    deadline.  If the success line already went out and only teardown is
+    hung, exit 0 so the recorded rc matches the good result."""
+    def fire():
+        # Attempt-the-emit-first avoids a check-then-act race with the
+        # main thread: _emit is atomic, so either our error line wins
+        # (no result existed -> exit 3) or a line already went out and
+        # its recorded kind decides the exit code.
+        if _emit(_error_json(
+                "bench_deadline_exceeded",
+                f"no result after {deadline_s:.0f}s; backend presumed "
+                f"hung mid-run (relay flap after a successful probe)")):
+            os._exit(3)
+        with _emit_lock:
+            ok = _emitted["ok"]
+        os._exit(0 if ok else 3)
+
+    t = threading.Timer(deadline_s, fire)
+    t.daemon = True
+    t.start()
 
 
 def _flops_per_client_round(fr, params) -> float | None:
@@ -159,9 +267,13 @@ def bench_workload(model: str, num_clients: int, client_block: int,
         per_sample = 1.5e9 if model == "resnet10" else 3.5e9
         flops_client = BATCH * LOCAL_STEPS * per_sample
         flops_src = "analytic_estimate"
-    # EXECUTED work only: the byzantine quarter's training is elided
-    # (dead under the ALIE forge), so it does not count toward MFU.
+    # Two MFU bases (VERDICT r4 weak #2 — keep the series comparable):
+    # "executed" counts only the benign training that actually runs (the
+    # byzantine quarter is elided: dead under the ALIE forge, round
+    # output bit-equal); "all_lanes" counts all n clients as rounds 1-3
+    # did, so r3's 17.35% compares against mfu_all_lanes.
     flops_per_round = (num_clients - num_byzantine) * flops_client
+    flops_all_lanes = num_clients * flops_client
 
     # Warmup / compile.
     state, m = step(state, x, y, lengths, mal, jax.random.PRNGKey(1))
@@ -178,10 +290,15 @@ def bench_workload(model: str, num_clients: int, client_block: int,
     dt = time.perf_counter() - t0
 
     rounds_per_sec = timed_rounds / dt
+    mfu_exec = round(rounds_per_sec * flops_per_round / V5E_BF16_PEAK_FLOPS, 4)
     return {
         "rounds_per_sec": round(rounds_per_sec, 3),
-        "mfu": round(rounds_per_sec * flops_per_round / V5E_BF16_PEAK_FLOPS, 4),
+        "mfu": mfu_exec,
+        "mfu_executed": mfu_exec,
+        "mfu_all_lanes": round(
+            rounds_per_sec * flops_all_lanes / V5E_BF16_PEAK_FLOPS, 4),
         "flops_per_round": flops_per_round,
+        "flops_per_round_all_lanes": flops_all_lanes,
         "flops_source": flops_src,
         "clients": num_clients,
         "byzantine": num_byzantine,
@@ -197,12 +314,27 @@ def bench_workload(model: str, num_clients: int, client_block: int,
 
 
 def main() -> None:
-    _wait_for_backend()
+    # Armed from process start (covers the probe too): rounds 1-3's happy
+    # path finished in well under 25 min, and round 4's driver kill came
+    # >=26 min in — the deadline must fire INSIDE the driver's window or
+    # a post-probe hang still ends rc=124 with no output.
+    _arm_watchdog(float(os.environ.get("BLADES_BENCH_DEADLINE_S", "1500")))
+    err = _wait_for_backend(
+        total_budget_s=float(os.environ.get("BLADES_BENCH_PROBE_BUDGET_S",
+                                            "300")))
+    if err is not None:
+        _emit(_error_json("backend_unavailable", err))
+        sys.exit(2)
 
-    r10 = bench_workload("resnet10", 1000, 50, timed_rounds=5)
+    try:
+        r10 = bench_workload("resnet10", 1000, 50, timed_rounds=5)
+    except Exception as e:
+        _emit(_error_json("resnet10_workload_failed",
+                          f"{type(e).__name__}: {e}"))
+        raise
 
     out = {
-        "metric": "fl_rounds_per_sec_1000clients_fedavg_alie_median_cifar10_resnet10",
+        "metric": METRIC_NAME,
         "value": r10["rounds_per_sec"],
         "unit": "rounds/s",
         "vs_baseline": round(r10["rounds_per_sec"] / BASELINE_EST_ROUNDS_PER_SEC, 2),
@@ -222,35 +354,43 @@ def main() -> None:
     }
 
     if os.environ.get("BLADES_BENCH_RESNET18", "1") == "1":
-        # n=768 (was 576 through round 3): malicious-lane elision stores
-        # only the 576 benign rows of the bf16 update matrix (12.9 GB) —
-        # the byzantine quarter's rows never exist — so the single-chip
-        # capacity grew by exactly the attack fraction.  client_block 24
-        # is the largest that fits (2.8 GB activation temps; 32 is a
-        # verified compile OOM) and measures ~1.5% over 16.
-        r18 = bench_workload("resnet18", 768, 24, timed_rounds=3)
-        rps8 = round(r18["rounds_per_sec"] * 768 * 8 / 1000 * 0.7, 2)
-        r18["note"] = (
-            "768 is the single-chip limit under malicious-lane elision "
-            "(the compacted matrix stores only the 576 benign rows = "
-            "12.9 GB; through r3 the full-matrix limit was n=576, with "
-            "n=640 a verified compile OOM at 16.66 > 15.75 GB HBM). "
-            "n=1000 (22.3 GB bf16 full) remains the multi-chip d-sharded "
-            "config (parallel/dsharded.py). Host-offload is infeasible "
-            "here: the relay moves 10-20 MB/s."
-        )
-        r18["projection_1000clients_v5e8"] = {
-            "rounds_per_sec": rps8,
-            "kind": "estimate",
-            "formula": "measured_768 x (768*8/1000 client-throughput "
-                       "scaling) x 0.7 collective/imbalance discount; "
-                       "training is client-parallel across chips (125 "
-                       "clients/chip) and the d-sharded finish passes "
-                       "2.8 GB/chip instead of 12.9 GB",
-        }
-        out["resnet18"] = r18
+        try:
+            out["resnet18"] = _resnet18_block()
+        except Exception as e:
+            # The headline must survive a secondary-workload failure.
+            out["resnet18"] = {"error": f"{type(e).__name__}: {e}"[:500]}
 
-    print(json.dumps(out))
+    _emit(out)
+
+
+def _resnet18_block() -> dict:
+    # n=768 (was 576 through round 3): malicious-lane elision stores
+    # only the 576 benign rows of the bf16 update matrix (12.9 GB) —
+    # the byzantine quarter's rows never exist — so the single-chip
+    # capacity grew by exactly the attack fraction.  client_block 24
+    # is the largest that fits (2.8 GB activation temps; 32 is a
+    # verified compile OOM) and measures ~1.5% over 16.
+    r18 = bench_workload("resnet18", 768, 24, timed_rounds=3)
+    rps8 = round(r18["rounds_per_sec"] * 768 * 8 / 1000 * 0.7, 2)
+    r18["note"] = (
+        "768 is the single-chip limit under malicious-lane elision "
+        "(the compacted matrix stores only the 576 benign rows = "
+        "12.9 GB; through r3 the full-matrix limit was n=576, with "
+        "n=640 a verified compile OOM at 16.66 > 15.75 GB HBM). "
+        "n=1000 (22.3 GB bf16 full) remains the multi-chip d-sharded "
+        "config (parallel/dsharded.py). Host-offload is infeasible "
+        "here: the relay moves 10-20 MB/s."
+    )
+    r18["projection_1000clients_v5e8"] = {
+        "rounds_per_sec": rps8,
+        "kind": "estimate",
+        "formula": "measured_768 x (768*8/1000 client-throughput "
+                   "scaling) x 0.7 collective/imbalance discount; "
+                   "training is client-parallel across chips (125 "
+                   "clients/chip) and the d-sharded finish passes "
+                   "2.8 GB/chip instead of 12.9 GB",
+    }
+    return r18
 
 
 if __name__ == "__main__":

@@ -1,0 +1,130 @@
+"""bench.py's graded-environment robustness (VERDICT r4 weak #1).
+
+Round 4's bench produced rc=124 and NO output because the backend probe
+retried ``jax.devices()`` in-process while each call hung ~26 minutes.
+These tests pin the hardened contract: the probe is subprocess-based
+with a hard deadline, the total wait is bounded, and the failure path
+emits a parseable single-line error JSON.
+"""
+
+import json
+import subprocess
+import sys
+
+sys.path.insert(0, __import__("os").path.dirname(
+    __import__("os").path.dirname(__import__("os").path.abspath(__file__))))
+
+import bench
+
+
+class _FakeClock:
+    """Deterministic monotonic clock: each hung probe 'takes' its full
+    timeout, sleeps advance by their argument — no real waiting."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def monotonic(self):
+        return self.t
+
+    def sleep(self, s):
+        self.t += s
+
+
+def _patch_clock(monkeypatch):
+    clk = _FakeClock()
+    monkeypatch.setattr(bench.time, "monotonic", clk.monotonic)
+    monkeypatch.setattr(bench.time, "sleep", clk.sleep)
+    return clk
+
+
+def test_probe_bounded_when_every_probe_hangs(monkeypatch):
+    clk = _patch_clock(monkeypatch)
+    calls = []
+
+    def fake_run(cmd, capture_output, text, timeout):
+        calls.append(timeout)
+        clk.t += timeout  # the hang consumes the probe's full deadline
+        raise subprocess.TimeoutExpired(cmd, timeout)
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+
+    err = bench._wait_for_backend(total_budget_s=300.0, probe_timeout_s=75.0)
+    assert err is not None and "hung" in err
+    # 75s probe + 20s sleep per iteration within a 300s budget.
+    assert 2 <= len(calls) <= 4
+    # Every probe got a hard deadline no larger than the per-probe cap,
+    # and none was launched with less than the 5s-minimum remaining.
+    assert all(5.0 <= t <= 75.0 for t in calls)
+    assert clk.t <= 300.0 + 75.0  # bounded overshoot: one probe width max
+
+
+def test_probe_returns_none_when_backend_reachable(monkeypatch):
+    def fake_run(cmd, capture_output, text, timeout):
+        return subprocess.CompletedProcess(cmd, 0, stdout="TPU\n", stderr="")
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    assert bench._wait_for_backend(total_budget_s=10.0) is None
+
+
+def test_probe_rejects_cpu_fallback(monkeypatch):
+    """A fast-failing axon plugin falls back to the CPU backend; that
+    must read as 'backend unavailable', not success (the bench's configs
+    only run on TPU)."""
+    _patch_clock(monkeypatch)
+    monkeypatch.delenv("BLADES_BENCH_ALLOW_CPU", raising=False)
+
+    def fake_run(cmd, capture_output, text, timeout):
+        return subprocess.CompletedProcess(cmd, 0, stdout="cpu\n", stderr="")
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    err = bench._wait_for_backend(total_budget_s=30.0)
+    assert err is not None and "fallback" in err
+
+    monkeypatch.setenv("BLADES_BENCH_ALLOW_CPU", "1")
+    assert bench._wait_for_backend(total_budget_s=30.0) is None
+
+
+def test_probe_surfaces_child_error_text(monkeypatch):
+    _patch_clock(monkeypatch)
+
+    def fake_run(cmd, capture_output, text, timeout):
+        return subprocess.CompletedProcess(
+            cmd, 1, stdout="", stderr="RuntimeError: relay said no")
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    err = bench._wait_for_backend(total_budget_s=30.0)
+    assert err is not None and "relay said no" in err
+
+
+def _reset_emit():
+    bench._emitted["done"] = False
+    bench._emitted["ok"] = False
+
+
+def test_error_json_is_single_parseable_line(capsys):
+    _reset_emit()
+    bench._emit(bench._error_json("backend_unavailable", "x" * 2000))
+    out = capsys.readouterr().out
+    lines = [l for l in out.splitlines() if l.strip()]
+    assert len(lines) == 1
+    obj = json.loads(lines[0])
+    assert obj["metric"] == bench.METRIC_NAME
+    assert obj["value"] is None
+    assert obj["error"] == "backend_unavailable"
+    assert len(obj["detail"]) <= 800
+
+
+def test_emit_is_once_only(capsys):
+    _reset_emit()
+    bench._emit({"a": 1})
+    bench._emit({"b": 2})  # watchdog racing the result: second is dropped
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    assert lines == [json.dumps({"a": 1})]
+    # A success line flips the ok flag, which the watchdog uses to decide
+    # between exit 0 (late teardown hang) and exit 3 (no result).
+    assert bench._emitted["ok"]
+    _reset_emit()
+    bench._emit(bench._error_json("backend_unavailable", "d"))
+    capsys.readouterr()
+    assert not bench._emitted["ok"]
