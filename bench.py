@@ -371,7 +371,6 @@ def _resnet18_block() -> dict:
     # is the largest that fits (2.8 GB activation temps; 32 is a
     # verified compile OOM) and measures ~1.5% over 16.
     r18 = bench_workload("resnet18", 768, 24, timed_rounds=3)
-    rps8 = round(r18["rounds_per_sec"] * 768 * 8 / 1000 * 0.7, 2)
     r18["note"] = (
         "768 is the single-chip limit under malicious-lane elision "
         "(the compacted matrix stores only the 576 benign rows = "
@@ -381,15 +380,17 @@ def _resnet18_block() -> dict:
         "config (parallel/dsharded.py). Host-offload is infeasible "
         "here: the relay moves 10-20 MB/s."
     )
-    r18["projection_1000clients_v5e8"] = {
-        "rounds_per_sec": rps8,
-        "kind": "estimate",
-        "formula": "measured_768 x (768*8/1000 client-throughput "
-                   "scaling) x 0.7 collective/imbalance discount; "
-                   "training is client-parallel across chips (125 "
-                   "clients/chip) and the d-sharded finish passes "
-                   "2.8 GB/chip instead of 12.9 GB",
-    }
+    # Derived projection (VERDICT r4 weak #5: the old x0.7 was a guess):
+    # executed-client compute scaling + the analytic per-chip ICI wire
+    # time of every collective the d-sharded round issues, with the
+    # collective inventory reconciled against compiled HLO
+    # (blades_tpu/parallel/comm_model.py, tests/test_comm_model.py).
+    from blades_tpu.parallel.comm_model import project_multichip_rounds_per_sec
+
+    r18["projection_1000clients_v5e8"] = project_multichip_rounds_per_sec(
+        measured_rps=r18["rounds_per_sec"],
+        n_benign_measured=576, n_target=1000, n_dev=8, d=r18["params"],
+        update_bytes=2, aggregator="Median", adversary="ALIE")
     return r18
 
 

@@ -273,11 +273,8 @@ class FedavgConfig:
                     "execution='dsharded' width-shards the update matrix "
                     "over a mesh; set .resources(num_devices=...) > 1"
                 )
-            if self.rounds_per_dispatch > 1:
-                raise ValueError(
-                    "execution='dsharded' is a single-round program; "
-                    "rounds_per_dispatch must be 1"
-                )
+            # rounds_per_dispatch > 1 chains k d-sharded rounds in one
+            # lax.scan'ed program (parallel/dsharded.dsharded_multi_step).
         if self.execution == "streamed":
             if self.num_devices and self.num_devices > 1:
                 raise ValueError(

@@ -105,11 +105,16 @@ class Fedavg:
             if cfg.execution == "dsharded" or (
                 cfg.execution == "auto" and self._dsharded_auto()
             ):
-                from blades_tpu.parallel.dsharded import dsharded_step
+                from blades_tpu.parallel.dsharded import (dsharded_multi_step,
+                                                          dsharded_step)
 
                 # Width-sharded giant-federation round: per-device memory
                 # is n*d/n_dev — the (n, d) matrix never exists anywhere.
-                self._step = dsharded_step(self.fed_round, self.mesh)
+                if self._chunk > 1:
+                    self._step = dsharded_multi_step(
+                        self.fed_round, self.mesh, self._chunk)
+                else:
+                    self._step = dsharded_step(self.fed_round, self.mesh)
             elif self._chunk > 1:
                 self._step = sharded_multi_step(
                     self.fed_round, self.mesh, self._chunk, donate=False
@@ -201,11 +206,9 @@ class Fedavg:
 
     def _dsharded_auto(self) -> bool:
         """On a mesh, pick the width-sharded round when the replicated
-        (n, d) matrix the gather formulations materialise per device would
-        strain HBM; also requires rounds_per_dispatch=1 (dsharded_step is
-        a single-round program)."""
-        if self._chunk > 1:
-            return False
+        (n, d) matrix the gather formulations materialise per device
+        would strain HBM (dsharded_multi_step covers rounds_per_dispatch
+        > 1 since round 5)."""
         return self._dense_matrix_bytes() > self.dense_matrix_hbm_limit()
 
     def _use_streamed(self) -> bool:

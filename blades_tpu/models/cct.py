@@ -273,6 +273,10 @@ def load_pretrained_params(params, path, *, resize_pos_embed=True,
     from pathlib import Path
 
     import numpy as np
+    # Imported BEFORE the suffix branches: the .msgpack branch uses it,
+    # and a later function-local import would make the name local to the
+    # whole function scope -> UnboundLocalError there (ADVICE r4).
+    from flax import traverse_util
 
     p = Path(path)
     if p.suffix == ".npz":
@@ -288,9 +292,19 @@ def load_pretrained_params(params, path, *, resize_pos_embed=True,
         raise ValueError(f"unsupported checkpoint format: {p.suffix!r} "
                          "(use .npz or .msgpack)")
 
-    from flax import traverse_util
-
     flat_dst = traverse_util.flatten_dict(params)
+    # The classifier head is the highest-numbered ROOT-level Dense (the
+    # SeqPool attention Dense precedes it in trace order).  Only ITS
+    # leaves may keep fresh init on a trailing-dim mismatch — the
+    # reference's fc_check exempts exactly the fc layer
+    # (cctnets/utils/helpers.py); a wrong-width BACKBONE checkpoint must
+    # raise, not silently lose layers to fresh init (ADVICE r4).
+    root_dense = sorted(
+        (k[0] for k in flat_dst
+         if len(k) == 2 and k[0].startswith("Dense_")
+         and k[0].split("_")[-1].isdigit()),
+        key=lambda s: int(s.split("_")[-1]))
+    head_module = root_dense[-1] if root_dense else None
     out = {}
     matched = 0
     skipped = []
@@ -321,8 +335,9 @@ def load_pretrained_params(params, path, *, resize_pos_embed=True,
                                     src.shape[-1]).astype(dst.dtype)
             matched += 1
             continue
-        if skip_mismatched_head and key[-1] in ("kernel", "bias") and (
-                src.shape[-1] != dst.shape[-1]):
+        if (skip_mismatched_head and key[0] == head_module
+                and key[-1] in ("kernel", "bias")
+                and src.shape[-1] != dst.shape[-1]):
             skipped.append(name)
             out[key] = dst  # different class count: fresh head
             continue

@@ -535,7 +535,17 @@ def streamed_step(
                                 sq, bad_rows)
 
     d_model = None  # resolved from params on first call
-    _checked_masks: set = set()  # mask ids whose prefix promise was verified
+    # Single-slot cache holding the LAST validated mask object.  The
+    # strong reference pins it so its id cannot be recycled; a bare
+    # id-set would let a freed-and-reallocated DIFFERENT mask at the
+    # same address silently skip validation (ADVICE r4), and an
+    # unbounded dict would pin every mask a fresh-mask-per-round caller
+    # ever passed.  The identity compare keeps the steady-state cost at
+    # nothing (a content digest would fetch the mask through the relay
+    # every round, ~85 ms); callers alternating between two mask
+    # objects re-pay validation, which no current caller does (Fedavg
+    # passes one cached mask for the run).
+    _checked_mask = [None]
 
     @partial(jax.jit, static_argnames=("rows", "nb", "d"))
     def _alloc_row_padded(rows, nb, d):
@@ -588,7 +598,7 @@ def streamed_step(
         if (malicious_prefix is not None and malicious_prefix > 0
                 and (coord_forges or row_forges)):
             skip_blocks = malicious_prefix // client_block
-            if skip_blocks and id(malicious) not in _checked_masks:
+            if skip_blocks and _checked_mask[0] is not malicious:
                 # Validate the caller's promise ONCE per mask object — a
                 # wrong mask would silently aggregate zero rows for
                 # benign clients.  Per-round checking would cost a
@@ -606,7 +616,7 @@ def streamed_step(
                         "benign updates (or treat trained malicious lanes "
                         "as benign on the compacted path)"
                     )
-                _checked_masks.add(id(malicious))
+                _checked_mask[0] = malicious
         # Benign-compacted fused finish: when the whole malicious prefix
         # is elided block-aligned, the matrix stores ONLY the benign rows
         # and the forged row enters the order statistics as a virtual row

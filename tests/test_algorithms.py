@@ -345,6 +345,31 @@ def test_dsharded_execution_requires_mesh():
         cfg.validate()
 
 
+def test_dsharded_rounds_per_dispatch_through_config():
+    """rounds_per_dispatch > 1 on execution='dsharded' (forced to 1
+    through round 4): one train() call advances the round counter by the
+    chunk and reduces health over the whole chunk."""
+    _, cfg = get_algorithm_class("FEDAVG", return_config=True)
+    cfg.update_from_dict({
+        "dataset_config": {"type": "mnist", "num_clients": 16, "train_bs": 8},
+        "global_model": "mlp",
+        "evaluation_interval": 4,
+        "execution": "dsharded",
+        "health_check": True,
+        "rounds_per_dispatch": 3,
+        "num_malicious_clients": 4,
+        "adversary_config": {"type": "ALIE"},
+        "server_config": {"lr": 1.0, "aggregator": {"type": "Median"}},
+    })
+    cfg.resources(num_devices=8)
+    algo = cfg.build()
+    r = algo.train()
+    assert r["training_iteration"] == 3
+    assert r["round_ok"] and r["num_unhealthy"] == 0
+    assert np.isfinite(r["train_loss"])
+    assert int(algo.state.server.round) == 3
+
+
 def test_dense_matrix_hbm_limit_is_device_derived(monkeypatch):
     """'auto' execution's dense budget: env override > device
     memory_stats > the 16 GB-chip fallback (VERDICT r3 item 7)."""

@@ -179,8 +179,10 @@ def test_cct_pretrained_weight_import(tmp_path):
     out = m100.apply({"params": merged}, jnp.zeros((2, 32, 32, 3)))
     assert out.shape == (2, 100)
 
-    # A checkpoint from a different model family matches nothing and
-    # must fail loudly instead of silently returning fresh init.
+    # A checkpoint from a different model family must fail loudly
+    # instead of silently returning fresh init.  (The MLP's Dense_0
+    # name-collides with CCT's SeqPool Dense, which since the ADVICE-r4
+    # head-only exemption is a BACKBONE leaf -> shape-mismatch error.)
     import pytest
 
     from blades_tpu.models import MLP
@@ -188,5 +190,52 @@ def test_cct_pretrained_weight_import(tmp_path):
     mp = mlp.init(jax.random.PRNGKey(3), jnp.zeros((1, 28, 28, 1)))["params"]
     wrong = tmp_path / "mlp.npz"
     save_params(mp, wrong)
-    with pytest.raises(ValueError, match="matched NO parameter"):
+    with pytest.raises(ValueError,
+                       match="shape mismatch|matched NO parameter"):
         load_pretrained_params(params, wrong)
+
+
+def test_cct_pretrained_import_msgpack_roundtrip(tmp_path):
+    """ADVICE r4: the .msgpack branch raised UnboundLocalError (late
+    function-local traverse_util import) and no test exercised it."""
+    import numpy as np
+    from flax import serialization
+
+    from blades_tpu.models.cct import cct_2_3x2_32, load_pretrained_params
+
+    m = cct_2_3x2_32()
+    params = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))["params"]
+    ckpt = tmp_path / "cct.msgpack"
+    ckpt.write_bytes(serialization.msgpack_serialize(
+        jax.tree.map(np.asarray, params)))
+
+    loaded = load_pretrained_params(params, ckpt)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(loaded)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_cct_pretrained_import_rejects_wrong_width_backbone(tmp_path):
+    """ADVICE r4: the fresh-init exemption is for the classifier HEAD
+    only (the reference's fc_check exempts exactly fc) — a trailing-dim
+    mismatch in a backbone layer must raise, not silently lose the layer
+    to fresh init."""
+    import numpy as np
+    import pytest
+    from flax import traverse_util
+
+    from blades_tpu.models.cct import (cct_2_3x2_32, load_pretrained_params,
+                                       save_params)
+
+    m = cct_2_3x2_32()
+    params = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))["params"]
+    flat = traverse_util.flatten_dict(params)
+    # Widen one ENCODER (backbone) Dense kernel's trailing dim.
+    bk = next(k for k in flat
+              if k[0].startswith("EncoderBlock") and k[-1] == "kernel")
+    flat[bk] = np.zeros(flat[bk].shape[:-1] + (flat[bk].shape[-1] + 8,),
+                        np.float32)
+    bad = tmp_path / "bad.npz"
+    save_params(traverse_util.unflatten_dict(flat), bad)
+
+    with pytest.raises(ValueError, match="shape mismatch"):
+        load_pretrained_params(params, bad)

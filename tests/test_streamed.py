@@ -218,6 +218,40 @@ def test_malicious_prefix_promise_is_validated(data):
         step(st, x, y, ln, bad_mask, jax.random.PRNGKey(7))
 
 
+def test_malicious_prefix_promise_check_is_per_object(data):
+    """The once-per-mask validation cache must hold the validated OBJECT,
+    not a recyclable id (ADVICE r4): a freed-and-reallocated DIFFERENT
+    mask at the recycled address must still be validated and raise."""
+    import gc
+
+    x, y, ln, _ = data
+    fr = make_fr("Median", "ALIE")
+    st = fr.init(jax.random.PRNGKey(0), N)
+    step = streamed_step(fr, client_block=2, d_chunk=10_000,
+                         update_dtype=jnp.float32, malicious_prefix=F,
+                         donate=False)
+    # A locally-created correct mask (the fixture's must stay alive, so
+    # its id could never be recycled and the test would prove nothing).
+    good = jnp.arange(N) < F
+    step(st, x, y, ln, good, jax.random.PRNGKey(7))
+
+    freed_id = id(good)
+    del good
+    gc.collect()
+    # Hunt for a wrong mask landing on the freed address.  Under the
+    # fixed cache the slot PINS the validated object, so no collision
+    # can occur and every wrong mask is validated; under a reverted
+    # bare-id cache a collision would silently skip validation (zeroing
+    # benign rows instead of raising) and fail this test.
+    for i in range(16):
+        bad = jnp.arange(N) >= (N - F)
+        with pytest.raises(ValueError, match="elision"):
+            step(st, x, y, ln, bad, jax.random.PRNGKey(8 + i))
+        if id(bad) == freed_id:
+            break  # the regression scenario itself was exercised
+        del bad
+
+
 def test_streamed_multi_round_dispatch_matches_sequential(data):
     """rounds_per_dispatch > 1 on the streamed path: k chained rounds
     (no host sync between them) must equal k sequential streamed_step
