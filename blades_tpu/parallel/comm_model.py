@@ -117,32 +117,38 @@ def _aggregator_volumes(
 
 def _adversary_volumes(adversary: Optional[str], n: int,
                        d_pad: int) -> List[CollectiveVolume]:
-    """Update-forging adversaries' psum'd global geometry
-    (:mod:`blades_tpu.adversaries.update_attacks`).  Coordinate-stat
-    forgers (ALIE, IPM, SignFlip, Noise, Adaptive's coordinate draw)
-    need NO cross-shard reduction on the width-sharded layout: every
-    chip holds full rows of its own columns."""
+    """Update-forging adversaries' psum'd global geometry, per the
+    registered names (:data:`blades_tpu.adversaries.ADVERSARIES`) and the
+    actual shard-aware implementations in
+    :mod:`blades_tpu.adversaries.update_attacks`.  Coordinate-stat
+    forgers (ALIE's mean+z*std, IPM's -scale*mean, Adaptive's
+    per-coordinate Fang deviation, Noise's keyed draw) and the
+    training-side attacks (SignFlip, LabelFlip) need NO cross-shard
+    reduction on the width-sharded layout: every chip holds full rows of
+    its own columns."""
     f4 = 4
-    if adversary in (None, "ALIE", "IPM", "SignFlip", "Noise", "LabelFlip",
-                     "Signguard_evasion"):
+    if adversary in (None, "ALIE", "IPM", "Adaptive", "Noise", "SignFlip",
+                     "LabelFlip"):
         return []
     if adversary == "MinMax":
-        # pairwise dists among rows + bisection distance checks
-        # (update_attacks.py:145-151, ~9 steps).
+        # pairwise dists among benign rows + per-bisection-step distance
+        # norms (update_attacks.py:145-151, ~9 steps).
         return [
             CollectiveVolume("minmax_pairwise", "psum", n * n * f4),
             CollectiveVolume("minmax_bisection_norms", "psum", n * f4, count=9),
         ]
-    if adversary == "MinSum":
+    if adversary == "SignGuard":
+        # global sign census of the benign mean: two scalar psums
+        # (update_attacks.py:243-244).
+        return [CollectiveVolume("signguard_sign_census", "psum", 2 * 4)]
+    if adversary == "Attackclippedclustering":
+        # row_norms + normalized gram + mean-angle dots
+        # (update_attacks.py:283-299).
         return [
-            CollectiveVolume("minsum_pairwise", "psum", n * n * f4),
-            CollectiveVolume("minsum_bisection_norms", "psum", n * f4, count=9),
+            CollectiveVolume("acc_row_norms", "psum", n * f4),
+            CollectiveVolume("acc_gram", "psum", n * n * f4),
+            CollectiveVolume("acc_mean_angles", "psum", (1 + n) * f4),
         ]
-    if adversary == "Fang":
-        # sign census of the benign mean (update_attacks.py:243-244).
-        return [CollectiveVolume("fang_sign_census", "psum", 2 * 4)]
-    if adversary == "Mimic":
-        return [CollectiveVolume("mimic_geometry", "psum", n * n * f4)]
     raise ValueError(f"no comm model for adversary {adversary!r}")
 
 

@@ -128,6 +128,20 @@ def test_model_inventory_matches_compiled_hlo(fed_data, aggregator,
     )
 
 
+def test_comm_model_covers_every_registered_name():
+    """Every registered adversary and every d-sharded aggregator must
+    resolve to a volume inventory — the model may never crash a
+    projection over a runnable configuration."""
+    from blades_tpu.adversaries import ADVERSARIES
+    from blades_tpu.ops.aggregators import AGGREGATORS
+
+    for adv in [None, *ADVERSARIES]:
+        for agg in AGGREGATORS:
+            vols = dsharded_round_volumes(16, 5000, 8, aggregator=agg,
+                                          adversary=adv)
+            assert vols and all(v.payload_bytes >= 0 for v in vols)
+
+
 def test_wire_bytes_ring_factors():
     # 1 MB payloads, k=8: a2a/ag send 7/8, psum sends 2*7/8.
     MB = 1 << 20

@@ -295,6 +295,39 @@ def test_compact_adaptive_matches_full():
                                atol=1e-5, rtol=1e-5)
 
 
+def test_compact_mxu_variants_match_default():
+    """The MXU radix-count formulation must be BIT-exact vs the VPU one
+    (the per-step counts are small integers, exact in f32); the MXU
+    stats formulation matches up to f32 reassociation ulps.  These are
+    the round-5 radix-headroom candidates (PERF_NOTES_r4: the radix is
+    ~43 ms of the ~80 ms compact finish, VPU-bound)."""
+    from blades_tpu.ops.pallas_round import fused_finish_compact
+
+    nb, mult, d = 40, 12, 1100
+    rng = np.random.default_rng(17)
+    for dtype in (jnp.float32, jnp.bfloat16):
+        xb = jnp.asarray(rng.normal(size=(nb, d)), dtype)
+        for agg in (("median",), ("trimmed", 7), ("mean",)):
+            base = fused_finish_compact(
+                xb, forged_mult=mult, forge=("alie", 1.5), agg=agg,
+                sanitize=True, interpret=True,
+                radix_mxu=False, stats_mxu=False)
+            counts = fused_finish_compact(
+                xb, forged_mult=mult, forge=("alie", 1.5), agg=agg,
+                sanitize=True, interpret=True,
+                radix_mxu=True, stats_mxu=False)
+            # radix_mxu alone: identical selection -> identical outputs.
+            for a, b in zip(base, counts):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+            allmxu = fused_finish_compact(
+                xb, forged_mult=mult, forge=("alie", 1.5), agg=agg,
+                sanitize=True, interpret=True,
+                radix_mxu=True, stats_mxu=True)
+            for a, b in zip(base[:2], allmxu[:2]):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           atol=2e-4, rtol=1e-4)
+
+
 def test_compact_rejects_forgeless():
     from blades_tpu.ops.pallas_round import fused_finish_compact
 
