@@ -38,38 +38,30 @@ import numpy as np
 sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
 
 
-def measure_h(h: float, *, n=30, f=9, rounds=6, noise=3.0, alpha=0.1,
-              model="resnet10", dataset="cifar10", seed=5):
+_FR_CACHE = {}
+
+
+def _build_round(n, f, model, input_shape, num_classes):
+    """fr + a jitted round compiled ONCE and reused for every h (the data
+    is an argument, not a closure — a per-h closure would recompile the
+    resnet10 round per grid point, ~25 min each on CPU)."""
     import jax
-    import jax.numpy as jnp
 
-    from blades_tpu.adversaries import get_adversary, make_malicious_mask
+    from blades_tpu.adversaries import get_adversary
     from blades_tpu.core import FedRound, Server, TaskSpec
-    from blades_tpu.data import DatasetCatalog
     from blades_tpu.data.sampler import sample_client_batches
-    from blades_tpu.ops import clustering, masked
-    from blades_tpu.ops.aggregators import DnC
 
-    ds = DatasetCatalog.get_dataset(
-        {"type": dataset, "synthetic_noise": noise,
-         "synthetic_heterogeneity": h},
-        num_clients=n, iid=False, alpha=alpha, seed=seed)
-    assert ds.synthetic
-    x = jnp.array(ds.train.x)
-    y = jnp.array(ds.train.y)
-    ln = jnp.array(ds.train.lengths)
-    mal = make_malicious_mask(n, f)
-    mal_np = np.asarray(mal)
-
-    task = TaskSpec(model=model, input_shape=ds.input_shape,
-                    num_classes=ds.num_classes, lr=0.1).build()
+    key = (n, f, model, input_shape, num_classes)
+    if key in _FR_CACHE:
+        return _FR_CACHE[key]
+    task = TaskSpec(model=model, input_shape=input_shape,
+                    num_classes=num_classes, lr=0.1).build()
     server = Server.from_config(aggregator="Mean", lr=1.0)
     adv = get_adversary("ALIE", num_clients=n, num_byzantine=f)
     fr = FedRound(task=task, server=server, adversary=adv, batch_size=32)
-    state = fr.init(jax.random.PRNGKey(0), n)
 
     @jax.jit
-    def round_updates(state, key):
+    def round_updates(state, x, y, ln, mal, key):
         """Mirror of FedRound.step up to the forged matrix (round.py:148-176),
         returning the matrix for measurement plus the advanced state."""
         k_sample, k_train, k_adv, k_agg, _ = jax.random.split(key, 5)
@@ -85,9 +77,38 @@ def measure_h(h: float, *, n=30, f=9, rounds=6, noise=3.0, alpha=0.1,
         server, _ = fr.server.step(state.server, forged, key=k_agg)
         return forged, type(state)(server=server, client_opt=client_opt)
 
+    _FR_CACHE[key] = (fr, round_updates)
+    return fr, round_updates
+
+
+def measure_h(h: float, *, n=30, f=9, rounds=6, noise=3.0, alpha=0.1,
+              model="resnet10", dataset="cifar10", seed=5):
+    import jax
+    import jax.numpy as jnp
+
+    from blades_tpu.adversaries import make_malicious_mask
+    from blades_tpu.data import DatasetCatalog
+    from blades_tpu.ops import clustering
+
+    ds = DatasetCatalog.get_dataset(
+        {"type": dataset, "synthetic_noise": noise,
+         "synthetic_heterogeneity": h},
+        num_clients=n, iid=False, alpha=alpha, seed=seed)
+    assert ds.synthetic
+    x = jnp.array(ds.train.x)
+    y = jnp.array(ds.train.y)
+    ln = jnp.array(ds.train.lengths)
+    mal = make_malicious_mask(n, f)
+    mal_np = np.asarray(mal)
+
+    fr, round_updates = _build_round(n, f, model, ds.input_shape,
+                                     ds.num_classes)
+    state = fr.init(jax.random.PRNGKey(0), n)
+
     rows = []
     for r in range(rounds):
-        forged, state = round_updates(state, jax.random.PRNGKey(100 + r))
+        forged, state = round_updates(state, x, y, ln, mal,
+                                      jax.random.PRNGKey(100 + r))
         U = np.asarray(forged, np.float64)
         ben = U[~mal_np]
         frg = U[mal_np]
@@ -119,13 +140,12 @@ def measure_h(h: float, *, n=30, f=9, rounds=6, noise=3.0, alpha=0.1,
         ccl_mask = np.asarray(clustering.agglomerative_majority(
             jnp.asarray(dist, jnp.float32), linkage="average"))
 
-        # DnC (aggregators.py DnC.aggregate semantics, one iteration).
-        dnc = DnC(num_byzantine=f, sub_dim=10000, num_iters=1)
-        _, _ = dnc(jnp.asarray(U, jnp.float32), (),
-                   key=jax.random.PRNGKey(r))
-        # Recompute its benign mask transparently.
-        rng = np.random.default_rng(r)
-        idx = rng.permutation(U.shape[1])[:10000]
+        # DnC's decision, recomputed transparently with the SAME
+        # coordinate subsample the aggregator would draw
+        # (aggregators.py DnC: idx = permutation(k_iter, d)[:sub_dim]
+        # for k_iter in split(key, num_iters); num_iters=1 here).
+        k_iter = jax.random.split(jax.random.PRNGKey(r), 1)[0]
+        idx = np.asarray(jax.random.permutation(k_iter, U.shape[1])[:10000])
         sub = U[:, idx]
         cen = sub - sub.mean(axis=0)
         v = np.linalg.svd(cen, full_matrices=False)[2][0]

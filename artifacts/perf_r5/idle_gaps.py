@@ -1,0 +1,106 @@
+"""Locate the ~20% device idle inside the streamed training block.
+
+PERF_NOTES_r4: the G=50 training block's device self-time is ~51.5 ms of
+64 ms wall — ~20% of the compiled program is DMA stalls / serialization
+that per-op self-time tables cannot attribute.  This captures a trace of
+the same block and reconstructs the DEVICE TIMELINE: merge all op
+intervals per device lane, then report the gaps (idle windows) with the
+ops bracketing each gap — the thing a self-time table hides.
+
+Run:  python artifacts/perf_r5/idle_gaps.py [variant] [outdir]
+(on the TPU; also runs on CPU to validate the parsing pipeline).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import sys
+from collections import defaultdict
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+sys.path.insert(0, str(Path(__file__).resolve().parents[1] / "perf_r4"))
+
+
+def load_trace_events(logdir: str):
+    """Trace-viewer JSON events out of the xplane proto."""
+    from xprof.convert import raw_to_tool_data as rtd
+
+    files = glob.glob(f"{logdir}/**/*.xplane.pb", recursive=True)
+    assert files, f"no xplane under {logdir}"
+    data, _ = rtd.xspace_to_tool_data(files, "trace_viewer", {})
+    if isinstance(data, bytes):
+        import gzip
+
+        try:
+            data = gzip.decompress(data)
+        except Exception:
+            pass
+        data = data.decode()
+    return json.loads(data)
+
+
+def device_gaps(trace: dict, min_gap_us: float = 20.0):
+    """Merge per-lane op intervals on DEVICE planes; report idle gaps."""
+    pids = {}
+    names = {}
+    for ev in trace.get("traceEvents", []):
+        if ev.get("ph") == "M" and ev.get("name") == "process_name":
+            pids[ev["pid"]] = ev["args"]["name"]
+    device_pids = {p for p, n in pids.items()
+                   if "TPU" in n or "/device" in n.lower() or "Device" in n}
+    lanes = defaultdict(list)
+    for ev in trace.get("traceEvents", []):
+        if ev.get("ph") == "X" and ev.get("pid") in device_pids:
+            lanes[(ev["pid"], ev.get("tid"))].append(
+                (ev["ts"], ev["ts"] + ev.get("dur", 0), ev.get("name", "?")))
+    report = {}
+    for lane, ivs in lanes.items():
+        ivs.sort()
+        t0, t1 = ivs[0][0], max(e for _, e, _ in ivs)
+        busy = 0.0
+        gaps = []
+        cur_end, cur_name = ivs[0][1], ivs[0][2]
+        busy_end = ivs[0][1]
+        for s, e, name in ivs[1:]:
+            if s > busy_end:
+                gaps.append((s - busy_end, cur_name, name, busy_end))
+            if e > busy_end:
+                busy += min(e - s, e - busy_end)
+                busy_end = e
+                cur_name = name
+        span = t1 - t0
+        gaps = [g for g in gaps if g[0] >= min_gap_us]
+        report[f"{pids[lane[0]]}/t{lane[1]}"] = {
+            "span_ms": round(span / 1e3, 3),
+            "busy_ms": round((span - sum(g[0] for g in gaps)) / 1e3, 3),
+            "idle_pct": round(100 * sum(g[0] for g in gaps) / span, 1),
+            "top_gaps": [
+                {"gap_us": round(g, 1), "after": a[:70], "before": b[:70]}
+                for g, a, b, _ in sorted(gaps, reverse=True)[:15]
+            ],
+        }
+    return report
+
+
+def main():
+    variant = sys.argv[1] if len(sys.argv) > 1 else "base"
+    logdir = sys.argv[2] if len(sys.argv) > 2 else f"/tmp/idle_{variant}"
+    import jax
+
+    from profile_block import build_run  # perf_r4 methodology
+
+    run = build_run(variant)
+    print("# compiling...", flush=True)
+    float(run())
+    with jax.profiler.trace(logdir):
+        float(run())
+    rep = device_gaps(load_trace_events(logdir))
+    print(json.dumps(rep, indent=1))
+    (Path(__file__).parent / f"idle_gaps_{variant}.json").write_text(
+        json.dumps(rep, indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,86 @@
+"""Time the compact fused finish: VPU baseline vs the MXU variants.
+
+PERF_NOTES_r4: the radix select is ~43 ms of the ~80 ms compact finish
+(VPU-bound, 16 steps x compare+reduce over the benign rows).  Round 5
+adds two opt-in formulations (ops/pallas_round.py):
+
+- ``radix_mxu``  — each radix step's row count as an MXU
+  ``ones @ indicator`` contraction (bit-exact).
+- ``stats_mxu``  — forged-row mean/var + row-norm reductions as MXU dots
+  (ulp-level reassociation differences).
+
+This measures all three at the bench headline shape (n=1000: 750 benign
+rows pre-padded to 752, d=4.9M bf16, ALIE forge + exact Median) with the
+r3 protocol: concrete final-output fetches, interleaved candidates, min
+over >= 6 passes.
+
+Run on the TPU:  python artifacts/perf_r5/time_finish_mxu.py
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NB, MULT = 750, 250          # 1000 clients, byzantine quarter elided
+D = 4_903_242                # ResNet-10 param count
+PASSES = 7
+
+
+def make_matrix():
+    rng = np.random.default_rng(0)
+    npad = -(-NB // 8) * 8
+    x = rng.normal(size=(npad, D)).astype(np.float32)
+    x[NB:] = np.inf
+    return jnp.asarray(x, jnp.bfloat16)
+
+
+def time_variant(x, radix_mxu, stats_mxu):
+    from blades_tpu.ops.pallas_round import fused_finish_compact
+
+    def run(key_val):
+        agg, sq, bad, forged = fused_finish_compact(
+            x, forged_mult=MULT, forge=("alie", 1.5), agg=("median",),
+            sanitize=True, num_real=NB,
+            radix_mxu=radix_mxu, stats_mxu=stats_mxu)
+        return agg
+
+    agg = run(0)
+    _ = float(agg[0])  # compile + concrete fetch
+    best = np.inf
+    for _ in range(PASSES):
+        t0 = time.perf_counter()
+        agg = run(0)
+        _ = float(agg[-1])
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    x = make_matrix()
+    out = {}
+    # Interleaved: one pass of each per outer loop would need restructure;
+    # min-of-7 per variant with the same resident matrix is the r3
+    # protocol's intent (steady-state, cache-warm).
+    for name, rm, sm in (("vpu_baseline", False, False),
+                         ("mxu_counts", True, False),
+                         ("mxu_all", True, True)):
+        out[name + "_s"] = round(time_variant(x, rm, sm), 4)
+        print(json.dumps({name: out[name + "_s"]}), flush=True)
+    out["speedup_counts"] = round(out["vpu_baseline_s"] / out["mxu_counts_s"], 3)
+    out["speedup_all"] = round(out["vpu_baseline_s"] / out["mxu_all_s"], 3)
+    (Path(__file__).parent / "finish_mxu_results.json").write_text(
+        json.dumps(out, indent=2))
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

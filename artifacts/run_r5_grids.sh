@@ -1,0 +1,38 @@
+#!/bin/bash
+# Round-5 TPU grid queue (VERDICT r4 #3/#4): run on the TPU, in this
+# order.  Each invocation is resumable (curves.json rewritten per cell).
+set -x
+cd "$(dirname "$0")/.."
+
+# 1. Complete IPM-100 to the reference 9x4 matrix (missing: the 10%
+#    column for the six existing aggregators + Trimmedmean/Multikrum/
+#    Centeredclipping everywhere).  ~18 cells x ~100 s.
+python -m blades_tpu.benchmarks.accuracy_curves \
+  --dataset cifar10 --rounds 200 --num-clients 60 \
+  --adversary '{"type": "IPM", "scale": 100.0}' \
+  --aggregators Mean Median Trimmedmean GeoMed Multikrum Centeredclipping Signguard Clippedclustering DnC \
+  --malicious 0 6 12 18 --noniid-alpha 0.1 --synthetic-noise 3.0 \
+  --rounds-per-dispatch 10 \
+  --resume-from artifacts/accuracy_curves/cifar10_ipm100/curves.json \
+  --out artifacts/accuracy_curves/cifar10_ipm100_r5
+
+# 2. Complete IPM-0.1 the same way (~19 cells).
+python -m blades_tpu.benchmarks.accuracy_curves \
+  --dataset cifar10 --rounds 200 --num-clients 60 \
+  --adversary '{"type": "IPM", "scale": 0.1}' \
+  --aggregators Mean Median Trimmedmean GeoMed Multikrum Centeredclipping Signguard Clippedclustering DnC \
+  --malicious 0 6 12 18 --noniid-alpha 0.1 --synthetic-noise 3.0 \
+  --rounds-per-dispatch 10 \
+  --resume-from artifacts/accuracy_curves/cifar10_ipm01/curves.json \
+  --out artifacts/accuracy_curves/cifar10_ipm01_r5
+
+# 3. ALIE-hard rerun with benign heterogeneity (h chosen from
+#    artifacts/alie_separability/results.json — fill in H below).
+H=${ALIE_H:?set ALIE_H from the separability measurement}
+python -m blades_tpu.benchmarks.accuracy_curves \
+  --dataset cifar10 --rounds 200 --num-clients 60 \
+  --adversary ALIE \
+  --aggregators Mean Median Trimmedmean GeoMed Multikrum Centeredclipping Signguard Clippedclustering DnC \
+  --malicious 0 6 12 15 18 --noniid-alpha 0.1 --synthetic-noise 3.0 \
+  --synthetic-heterogeneity "$H" --rounds-per-dispatch 10 \
+  --out artifacts/accuracy_curves/cifar10_alie_het

@@ -134,12 +134,61 @@ def main(argv=None) -> int:
     p.add_argument("--compute-dtype", default=None,
                    help="e.g. bfloat16 — needed for batch 64 on a 16 GB "
                    "chip (f32 activations OOM)")
+    p.add_argument("--resume-from", default=None,
+                   help="path to an existing curves.json: its rows seed "
+                   "this run and already-run (aggregator, num_malicious) "
+                   "cells are skipped — the way to COMPLETE a grid "
+                   "toward the reference matrix without re-running "
+                   "finished cells")
     args = p.parse_args(argv)
 
     model = args.model or MODELS.get(args.dataset, "mlp")
     out = Path(args.out)
     out.mkdir(parents=True, exist_ok=True)
     rows = []
+    if args.resume_from:
+        prior = json.loads(Path(args.resume_from).read_text())
+
+        def norm_adv(a):
+            try:
+                return json.loads(a) if isinstance(a, str) \
+                    and a.lstrip().startswith("{") else a
+            except Exception:
+                return a
+
+        # Seed only cells whose run configuration matches this one —
+        # stitching cells from a different attack/data/seed config would
+        # produce a curves.json claiming completeness for incomparable
+        # cells.  Keys ABSENT from the prior artifact (pre-round-5 grids
+        # don't stamp seed/heterogeneity) are warned about, not failed —
+        # the comparison cannot be made.
+        checks = {
+            "dataset": args.dataset, "model": model,
+            "adversary": args.adversary, "rounds": args.rounds,
+            "num_clients": args.num_clients,
+            "noniid_alpha": args.noniid_alpha,
+            "synthetic_noise": args.synthetic_noise,
+            "synthetic_heterogeneity": args.synthetic_heterogeneity,
+            "client_lr": args.client_lr, "server_lr": args.server_lr,
+            "batch_size": args.batch_size,
+            "compute_dtype": args.compute_dtype, "seed": args.seed,
+        }
+        for k, ours in checks.items():
+            if k not in prior:
+                print(f"# WARNING: --resume-from artifact predates the "
+                      f"{k!r} stamp; cannot verify it matches {ours!r}",
+                      flush=True)
+                continue
+            theirs = prior[k]
+            if k == "adversary":
+                theirs, ours = norm_adv(theirs), norm_adv(ours)
+            if theirs != ours:
+                raise SystemExit(
+                    f"--resume-from config mismatch on {k!r}: "
+                    f"{theirs} != {ours}")
+        rows = list(prior["rows"])
+        print(f"# resumed {len(rows)} cells from {args.resume_from}",
+              flush=True)
 
     # The reference figure's cells for this client count.
     ref_malicious = sorted({int(round(f * args.num_clients))
@@ -168,10 +217,12 @@ def main(argv=None) -> int:
             "server_lr": args.server_lr,
             "batch_size": args.batch_size,
             "compute_dtype": args.compute_dtype,
+            "seed": args.seed,
             "planned": {"aggregators": list(args.aggregators),
                         "malicious": list(args.malicious)},
-            "planned_complete": (
-                len(rows) == len(args.aggregators) * len(args.malicious)),
+            "planned_complete": all(
+                (a, m) in ran for a in args.aggregators
+                for m in args.malicious),
             "reference_grid": {"aggregators": REFERENCE_AGGREGATORS,
                                "malicious": ref_malicious},
             "reference_cells_missing": sorted(
@@ -182,8 +233,11 @@ def main(argv=None) -> int:
         (out / "curves.json").write_text(json.dumps(table, indent=2))
         return synthetic
 
+    done = {(r["aggregator"], r["num_malicious"]) for r in rows}
     for agg in args.aggregators:
         for m in args.malicious:
+            if (agg, m) in done:
+                continue
             t0 = time.perf_counter()
             row = run_cell(args.dataset, model, agg, m, args.adversary,
                            args.rounds, args.seed, args.num_clients,
@@ -209,10 +263,15 @@ def main(argv=None) -> int:
     import matplotlib.pyplot as plt
 
     fig, ax = plt.subplots(figsize=(7, 5))
-    for agg in args.aggregators:
-        pts = [(r["num_malicious"], r["final_test_acc"]) for r in rows
-               if r["aggregator"] == agg]
-        ax.plot(*zip(*pts), marker="o", label=agg)
+    # Union of planned and resumed aggregators, so a completion run's
+    # plot shows the whole stitched grid.
+    plot_aggs = list(dict.fromkeys(
+        [*args.aggregators, *(r["aggregator"] for r in rows)]))
+    for agg in plot_aggs:
+        pts = sorted((r["num_malicious"], r["final_test_acc"]) for r in rows
+                     if r["aggregator"] == agg)
+        if pts:
+            ax.plot(*zip(*pts), marker="o", label=agg)
     ax.set_xlabel("# malicious clients")
     ax.set_ylabel(f"test accuracy after {args.rounds} rounds")
     title = f"{args.dataset}/{model} vs {args.adversary}"

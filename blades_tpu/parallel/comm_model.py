@@ -50,13 +50,24 @@ class CollectiveVolume:
     kind: ``all_to_all`` | ``all_gather`` | ``psum``.
     payload_bytes: bytes per chip entering the op (for ``all_gather``,
         the gathered OUTPUT size — that is what rides the wire).
-    count: how many times the round issues it.
+    count: how many times the round EXECUTES it (wire bytes scale by
+        this).
+    in_loop: the op lives inside a ``lax.fori_loop`` body, so it appears
+        ONCE in the static HLO while executing ``count`` times — the
+        HLO-reconciliation test compares static totals, the wire model
+        uses dynamic counts.
     """
 
     label: str
     kind: str
     payload_bytes: int
     count: int = 1
+    in_loop: bool = False
+
+    @property
+    def static_bytes(self) -> int:
+        """Bytes of this op as it appears in the lowered HLO text."""
+        return self.payload_bytes * (1 if self.in_loop else self.count)
 
     def wire_bytes(self, k: int) -> int:
         """Ring-transmitted bytes per chip for mesh size ``k``."""
@@ -84,18 +95,20 @@ def _aggregator_volumes(
         "Trimmedmean": [],
         # pairwise_sq_dists: one (n, n) psum (dsharded.py Multikrum).
         "Multikrum": [CollectiveVolume("pairwise_sq_dists", "psum", n * n * f4)],
-        # row_norms psum per Weiszfeld iteration.
+        # row_norms psum per Weiszfeld iteration (fori_loop body).
         "GeoMed": [CollectiveVolume("weiszfeld_row_norms", "psum", n * f4,
-                                    count=geomed_maxiter)],
+                                    count=geomed_maxiter, in_loop=True)],
         # (n, sub_dim) sampled-column assembly per iteration.
         "DnC": [CollectiveVolume("sampled_columns", "psum",
                                  n * dnc_sub_dim * f4, count=dnc_num_iters)],
         # s_norm scalar + row_norms + row_dots.
         "FLTrust": [CollectiveVolume("trust_geometry", "psum",
                                      (1 + n + n) * f4)],
-        # clip row_norms per inner iteration + momentum all_gather.
+        # clip row_norms per inner iteration (fori_loop) + momentum
+        # all_gather.
         "Centeredclipping": [
-            CollectiveVolume("clip_row_norms", "psum", n * f4, count=cc_n_iter),
+            CollectiveVolume("clip_row_norms", "psum", n * f4,
+                             count=cc_n_iter, in_loop=True),
             CollectiveVolume("momentum_gather", "all_gather", d_pad * f4),
         ],
         # row_norms + sign census (pos/neg int32 counts).
@@ -131,11 +144,13 @@ def _adversary_volumes(adversary: Optional[str], n: int,
                      "LabelFlip"):
         return []
     if adversary == "MinMax":
-        # pairwise dists among benign rows + per-bisection-step distance
-        # norms (update_attacks.py:145-151, ~9 steps).
+        # pairwise dists among benign rows + one distance-norm psum per
+        # bisection step (update_attacks.py:145-160,
+        # MinMaxAdversary.iters = 12).
         return [
             CollectiveVolume("minmax_pairwise", "psum", n * n * f4),
-            CollectiveVolume("minmax_bisection_norms", "psum", n * f4, count=9),
+            CollectiveVolume("minmax_bisection_norms", "psum", n * f4,
+                             count=12, in_loop=True),
         ]
     if adversary == "SignGuard":
         # global sign census of the benign mean: two scalar psums

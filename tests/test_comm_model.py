@@ -100,6 +100,7 @@ def compiled_collectives(fr, fed_data):
     ("Median", "ALIE", False),   # the bench headline round
     ("Median", "ALIE", True),
     ("Multikrum", "IPM", False),
+    ("Median", "MinMax", False),  # grounds the 12-step bisection count
 ])
 def test_model_inventory_matches_compiled_hlo(fed_data, aggregator,
                                               adversary, health):
@@ -112,17 +113,22 @@ def test_model_inventory_matches_compiled_hlo(fed_data, aggregator,
         N, d, 8, update_bytes=4,  # f32 updates on the CPU test config
         aggregator=aggregator, adversary=adversary, health_check=health)
 
-    # XLA's all-reduce combiner may MERGE independent psums into one op
-    # (seen: Multikrum's pairwise 1024 B + metrics row_norms 64 B ->
-    # a single 1088 B all-reduce), so reconcile total payload bytes per
-    # collective kind — exactly the quantity the wire model consumes.
+    # Two structural caveats make per-op matching impossible:
+    # - XLA's all-reduce combiner may MERGE independent psums into one
+    #   op (seen: Multikrum's pairwise 1024 B + metrics row_norms 64 B
+    #   -> a single 1088 B all-reduce);
+    # - a psum inside a lax.fori_loop body appears ONCE in the static
+    #   HLO while executing `count` times (MinMax's 12 bisection steps).
+    # So reconcile STATIC total payload bytes per collective kind; the
+    # wire model separately scales loop-resident ops by their dynamic
+    # count (CollectiveVolume.in_loop documents which is which).
     def totals(pairs):
         t = {}
         for kind, b in pairs:
             t[kind] = t.get(kind, 0) + b
         return t
 
-    want = totals((v.kind, v.payload_bytes * v.count) for v in vols)
+    want = totals((v.kind, v.static_bytes) for v in vols)
     assert totals(got) == want, (
         f"compiled HLO collectives {sorted(got)} != model {sorted(want.items())}"
     )
