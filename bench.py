@@ -390,7 +390,8 @@ def _resnet18_block() -> dict:
     r18["projection_1000clients_v5e8"] = project_multichip_rounds_per_sec(
         measured_rps=r18["rounds_per_sec"],
         n_benign_measured=576, n_target=1000, n_dev=8, d=r18["params"],
-        update_bytes=2, aggregator="Median", adversary="ALIE")
+        update_bytes=2, aggregator="Median", adversary="ALIE",
+        num_malicious=250)
     return r18
 
 

@@ -90,11 +90,36 @@ class Fedavg:
 
         self._chunk = max(1, int(getattr(cfg, "rounds_per_dispatch", 1)))
         self.mesh = None
+        # Client permutation applied to the stacked arrays (d-sharded
+        # elision layout); None = natural order.  Checkpoints record it
+        # so per-client state realigns across execution modes.
+        self._client_order = None
         if cfg.num_devices and cfg.num_devices > 1:
             from blades_tpu.parallel import make_mesh, shard_federation, sharded_step
             from blades_tpu.parallel.sharded import sharded_evaluate, sharded_multi_step
 
             self.mesh = make_mesh(num_devices=cfg.num_devices)
+            use_dsharded = cfg.execution == "dsharded" or (
+                cfg.execution == "auto" and self._dsharded_auto()
+            )
+            mal_prefix = self._dsharded_elision_prefix() if use_dsharded \
+                else None
+            if mal_prefix:
+                # Malicious-lane elision needs every chip's local lanes
+                # laid out [f/n_dev malicious | benign]: permute the
+                # client axis BEFORE sharding (client identity rides
+                # along — data, mask, and per-client test shards move
+                # together; opt-state init is client-symmetric).
+                from blades_tpu.parallel.dsharded import elision_client_order
+
+                self._client_order = elision_client_order(
+                    cfg.num_clients, mal_prefix, cfg.num_devices)
+                order = jnp.asarray(self._client_order)
+                self._train_arrays = tuple(a[order]
+                                           for a in self._train_arrays)
+                self._test_arrays = tuple(a[order]
+                                          for a in self._test_arrays)
+                self.malicious = self.malicious[order]
             self.state, arrays = shard_federation(
                 self.mesh, self.state, self._train_arrays + (self.malicious,)
             )
@@ -102,9 +127,7 @@ class Fedavg:
             _, self._test_arrays = shard_federation(
                 self.mesh, self.state, self._test_arrays
             )
-            if cfg.execution == "dsharded" or (
-                cfg.execution == "auto" and self._dsharded_auto()
-            ):
+            if use_dsharded:
                 from blades_tpu.parallel.dsharded import (dsharded_multi_step,
                                                           dsharded_step)
 
@@ -112,9 +135,11 @@ class Fedavg:
                 # is n*d/n_dev — the (n, d) matrix never exists anywhere.
                 if self._chunk > 1:
                     self._step = dsharded_multi_step(
-                        self.fed_round, self.mesh, self._chunk)
+                        self.fed_round, self.mesh, self._chunk,
+                        malicious_prefix=mal_prefix)
                 else:
-                    self._step = dsharded_step(self.fed_round, self.mesh)
+                    self._step = dsharded_step(self.fed_round, self.mesh,
+                                               malicious_prefix=mal_prefix)
             elif self._chunk > 1:
                 self._step = sharded_multi_step(
                     self.fed_round, self.mesh, self._chunk, donate=False
@@ -203,6 +228,24 @@ class Fedavg:
     def _dense_matrix_bytes(self) -> int:
         d = sum(p.size for p in jax.tree.leaves(self.state.server.params))
         return self.config.num_clients * d * 4
+
+    def _dsharded_elision_prefix(self):
+        """Malicious-lane training elision on the d-sharded path: sound
+        exactly when every malicious lane's update is REPLACED by a
+        forge computed from benign statistics (update-forging
+        adversaries; training-side attacks train for real), and the
+        counts divide the mesh so the strided layout is uniform."""
+        from blades_tpu.parallel.streamed import _adv_forges
+
+        cfg = self.config
+        f = int(cfg.num_malicious_clients or 0)
+        if not f or not _adv_forges(self.fed_round.adversary):
+            return None
+        # floor(f/n_dev) lanes elide per chip; below one per chip there
+        # is nothing to skip and the permutation would be pointless.
+        if cfg.num_clients % cfg.num_devices or f < cfg.num_devices:
+            return None
+        return f
 
     def _dsharded_auto(self) -> bool:
         """On a mesh, pick the width-sharded round when the replicated
@@ -354,6 +397,13 @@ class Fedavg:
             "rounds_since_eval": self._rounds_since_eval,
             "key": jax.device_get(self._key),
             "state": jax.device_get(self.state),
+            # Which client sits in each stacked row (the d-sharded
+            # elision layout permutes clients at setup): lets a resume
+            # under a DIFFERENT execution mode realign per-client state
+            # instead of silently pairing client i's optimizer with
+            # client j's data.
+            "client_order": (None if self._client_order is None
+                             else list(map(int, self._client_order))),
             "config_dict": {k: v for k, v in self.config.items()
                             if not callable(v)},
         }
@@ -372,6 +422,25 @@ class Fedavg:
         self._rounds_since_eval = payload.get("rounds_since_eval", 0)
         self._key = jnp.asarray(payload["key"])
         state = jax.tree.map(jnp.asarray, payload["state"])
+        # Realign per-client state when the saved client layout differs
+        # from this instance's (e.g. a dense-run checkpoint resumed on
+        # the d-sharded elision layout, or vice versa).  Saved row j
+        # holds client saved_order[j]; this instance's row i must hold
+        # client cur_order[i].
+        import numpy as np
+
+        n = self.config.num_clients
+        saved = payload.get("client_order") or list(range(n))
+        cur = (list(range(n)) if self._client_order is None
+               else list(map(int, self._client_order)))
+        if saved != cur:
+            inv_saved = np.argsort(np.asarray(saved))
+            remap = jnp.asarray(inv_saved[np.asarray(cur)])
+            state = type(state)(
+                server=state.server,
+                client_opt=jax.tree.map(lambda a: a[remap],
+                                        state.client_opt),
+            )
         if self.mesh is not None:
             from blades_tpu.parallel import shard_federation
 

@@ -213,6 +213,7 @@ def project_multichip_rounds_per_sec(
     measured_rps: float, n_benign_measured: int,
     n_target: int, n_dev: int, d: int, *, update_bytes: int = 2,
     aggregator: str = "Median", adversary: Optional[str] = "ALIE",
+    num_malicious: int = 0,
     ici_bytes_per_sec: float = V5E_ICI_BYTES_PER_SEC,
 ) -> dict:
     """The v5e-8 projection with a DERIVED comm term.
@@ -226,13 +227,19 @@ def project_multichip_rounds_per_sec(
     training).  Returns the projection plus its full provenance.
     """
     t_measured = 1.0 / measured_rps
-    # The compute unit is TRAINED client-rounds/sec: the measured
-    # single-chip round trains only its benign lanes (malicious-lane
-    # elision), but the d-sharded round trains EVERY local lane —
-    # update forging happens post-swap and the block-skip structure
-    # does not survive the client-shard layout — so the target count
-    # is all n_target/n_dev lanes per chip, not just the benign ones.
-    t_compute = (t_measured * (n_target / n_dev) / n_benign_measured)
+    # The compute unit is TRAINED client-rounds/sec.  The d-sharded
+    # round elides floor(f/n_dev) malicious lanes per chip, but ONLY
+    # under the same gates the runtime applies
+    # (Fedavg._dsharded_elision_prefix): an update-FORGING adversary
+    # (training-side attacks train for real), f >= n_dev, and n
+    # divisible by the mesh; otherwise every lane trains.
+    forging = adversary in ("ALIE", "IPM", "Noise", "MinMax", "Adaptive",
+                            "SignGuard", "Attackclippedclustering")
+    elides = (forging and num_malicious >= n_dev
+              and n_target % n_dev == 0)
+    trained_per_chip = (-(-n_target // n_dev)
+                        - (num_malicious // n_dev if elides else 0))
+    t_compute = t_measured * trained_per_chip / n_benign_measured
     vols = dsharded_round_volumes(
         n_target, d, n_dev, update_bytes=update_bytes,
         aggregator=aggregator, adversary=adversary)
@@ -247,12 +254,14 @@ def project_multichip_rounds_per_sec(
         "ici_bytes_per_sec": ici_bytes_per_sec,
         "dominant_collective": max(
             vols, key=lambda v: v.wire_bytes(n_dev)).label,
+        "trained_lanes_per_chip": trained_per_chip,
         "assumptions": (
             "no compute/comm overlap (conservative); one-axis ring at "
             "the public one-way per-link ICI figure; trained-client "
-            "throughput scaling from the measured single-chip round "
-            "(the d-sharded round trains ALL lanes — no malicious-lane "
-            "elision on the client-shard layout); collective inventory "
+            "throughput scaling from the measured single-chip round, "
+            "with floor(f/n_dev) malicious lanes elided per chip "
+            "(dsharded malicious_prefix + elision_client_order, exact "
+            "per tests/test_dsharded.py); collective inventory "
             "reconciled against compiled HLO (tests/test_comm_model.py)"
         ),
     }

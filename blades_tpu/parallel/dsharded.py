@@ -47,7 +47,7 @@ from jax import lax, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from blades_tpu.core.round import FedRound, RoundState
-from blades_tpu.data.sampler import sample_client_batches
+from blades_tpu.data.sampler import sample_client_batches_with_keys
 from blades_tpu.ops import clustering, layout as L, masked
 from blades_tpu.ops.aggregators import (
     Centeredclipping,
@@ -252,13 +252,45 @@ def _aggregate_dshard(
     )
 
 
-def _build_dsharded_body(fr: FedRound, mesh: Mesh) -> Callable:
+def _build_dsharded_body(fr: FedRound, mesh: Mesh,
+                         malicious_prefix: Optional[int] = None) -> Callable:
     """The un-jitted shard_map round body — reused by the single-round
-    :func:`dsharded_step` jit and the :func:`dsharded_multi_step` scan."""
-    adv_forges = fr.adversary is not None and hasattr(
-        fr.adversary, "on_updates_ready"
-    )
+    :func:`dsharded_step` jit and the :func:`dsharded_multi_step` scan.
+
+    ``malicious_prefix``: the streamed path's malicious-lane training
+    ELISION (parallel/streamed.py), on the client-shard layout.  Every
+    update-forging adversary computes its forged rows from BENIGN
+    statistics only and replaces the malicious rows wholesale
+    (``scatter_forged``), so what those lanes train is dead computation
+    — with ``malicious_prefix = f`` each chip trains only its benign
+    lanes and writes zero rows for the malicious ones, which the forge
+    then overwrites post-swap.  Exact: bit-equal round output (DP rows
+    are clipped/noised per-row, so zeroed dead rows stay dead;
+    tests/test_dsharded.py).  One telemetry caveat: ``num_unhealthy``
+    counts only TRAINED lanes — an elided malicious lane whose real
+    training would have produced non-finite values reads as healthy
+    (its zero row is finite), so health counts can differ from the
+    non-elided round even though server state is bit-equal.  Requires the STRIDED client layout —
+    every chip's local lanes are ``[f/n_dev malicious | benign]`` —
+    produced by :func:`elision_client_order`; the step wrapper validates
+    the caller's mask against that promise once per mask object.
+    Ignored (trains everyone) when the adversary does not forge
+    updates: a training-side attack's malicious lanes do real work.
+    """
+    # Override check, not hasattr: the Adversary base class defines an
+    # identity on_updates_ready, and a training-side attack (SignFlip)
+    # must keep training its lanes.
+    from blades_tpu.parallel.streamed import _adv_forges
+
+    adv_forges = _adv_forges(fr.adversary)
     n_dev = mesh.devices.size
+    f_local = 0
+    if malicious_prefix and adv_forges:
+        # floor(f / n_dev) lanes elided per chip; the f mod n_dev
+        # remainder malicious lanes sit in the tails and train
+        # harmlessly (their rows are forged over anyway), keeping the
+        # per-chip shapes uniform for SPMD.
+        f_local = malicious_prefix // n_dev
     state_spec = RoundState(server=P(), client_opt=P(AXIS))
     data_spec = P(AXIS)
 
@@ -275,16 +307,37 @@ def _build_dsharded_body(fr: FedRound, mesh: Mesh) -> Callable:
         dev_key = jax.random.fold_in(k_local, lax.axis_index(AXIS))
         k_sample, k_train = jax.random.split(dev_key)
 
-        bx, by = sample_client_batches(
-            k_sample, data_x, data_y, lengths, fr.batch_size, fr.num_batches_per_round
-        )
         hooks = fr._hooks()
+        # Keys are pre-split over ALL local lanes and sliced, so the
+        # benign lanes draw byte-identical batches/train streams whether
+        # or not the malicious prefix is elided.
+        sample_keys = jax.random.split(k_sample, n_local)
         client_keys = jax.random.split(k_train, n_local)
 
-        upd_local, client_opt, losses_local = fr.task.local_round_batched(
-            state.server.params, state.client_opt, bx, by, client_keys,
-            malicious, *hooks,
-        )
+        def train(slc):
+            bx, by = sample_client_batches_with_keys(
+                sample_keys[slc], data_x[slc], data_y[slc], lengths[slc],
+                fr.batch_size, fr.num_batches_per_round)
+            return fr.task.local_round_batched(
+                state.server.params,
+                jax.tree.map(lambda a: a[slc], state.client_opt),
+                bx, by, client_keys[slc], malicious[slc], *hooks)
+
+        if f_local:
+            # Elision: train only the benign tail; the malicious-prefix
+            # lanes get zero rows (replaced by the forge post-swap),
+            # zero losses (benign-masked out of train_loss), and keep
+            # their (dead) optimizer state untouched.
+            upd_b, opt_b, losses_b = train(slice(f_local, None))
+            upd_local = jnp.concatenate(
+                [jnp.zeros((f_local, upd_b.shape[1]), upd_b.dtype), upd_b])
+            losses_local = jnp.concatenate(
+                [jnp.zeros((f_local,), losses_b.dtype), losses_b])
+            client_opt = jax.tree.map(
+                lambda dead, new: jnp.concatenate([dead[:f_local], new]),
+                state.client_opt, opt_b)
+        else:
+            upd_local, client_opt, losses_local = train(slice(None))
         upd_local = fr.apply_dp(
             upd_local, jax.random.fold_in(k_dp, lax.axis_index(AXIS))
         )
@@ -364,10 +417,70 @@ def _build_dsharded_body(fr: FedRound, mesh: Mesh) -> Callable:
             metrics["round_ok"] = ok
         return RoundState(server=server, client_opt=client_opt), metrics
 
+    _step.f_local = f_local
     return _step
 
 
-def dsharded_step(fr: FedRound, mesh: Mesh) -> Callable:
+def elision_client_order(n: int, f: int, n_dev: int):
+    """Client permutation for d-sharded malicious-lane elision.
+
+    With the canonical prefix mask (clients ``0..f-1`` malicious) and
+    contiguous sharding, whole chips would be all-malicious; elision
+    needs every chip's LOCAL lanes to start with ``floor(f/n_dev)``
+    malicious clients.  The ``f mod n_dev`` remainder malicious clients
+    are placed in the first chips' TAILS, where they train harmlessly
+    (uniform per-chip shapes; their rows are forged over regardless).
+    Returns ``order`` such that ``array[order]`` lays clients out that
+    way.
+    """
+    import numpy as np
+
+    if n % n_dev:
+        raise ValueError(f"n={n} must divide the mesh ({n_dev})")
+    if not (0 < f < n):
+        raise ValueError(f"f={f} must be in (0, {n})")
+    fl, r, nl = f // n_dev, f % n_dev, n // n_dev
+    mal = iter(range(f))
+    ben = iter(range(f, n))
+    order = []
+    for k in range(n_dev):
+        extra = 1 if k < r else 0
+        order += [next(mal) for _ in range(fl + extra)]
+        order += [next(ben) for _ in range(nl - fl - extra)]
+    return np.asarray(order)
+
+
+def _validated(step, n_dev: int, f_local: int) -> Callable:
+    """Wrap a jitted d-sharded step with the once-per-mask-object check
+    that the caller's mask really is per-chip ``[f_local | benign]`` —
+    a wrong mask would silently zero benign training (same promise
+    validation as the streamed path, streamed.py)."""
+    if not f_local:
+        return step
+    checked = [None]  # single slot pins the validated object (ADVICE r4)
+
+    def wrapped(state, data_x, data_y, lengths, malicious, key):
+        if checked[0] is not malicious:
+            import numpy as np
+
+            # Only the ELIDED prefix must be all-malicious — a benign
+            # lane there would silently lose its training.  Malicious
+            # lanes in the tail are fine (they train, then get forged).
+            m = np.asarray(malicious).reshape(n_dev, -1)
+            if not m[:, :f_local].all():
+                raise ValueError(
+                    f"d-sharded elision promised every chip's first "
+                    f"{f_local} lanes malicious, but the mask disagrees "
+                    "— lay clients out with elision_client_order, or "
+                    "build the step without malicious_prefix")
+            checked[0] = malicious
+        return step(state, data_x, data_y, lengths, malicious, key)
+
+    return wrapped
+
+
+def dsharded_step(fr: FedRound, mesh: Mesh,
+                  malicious_prefix: Optional[int] = None) -> Callable:
     """The giant-federation round: local training on client shards, ONE
     all-to-all to width shards, exact aggregation, and an all-gather of
     only the final ``(d,)`` aggregate into the replicated server step.
@@ -380,11 +493,19 @@ def dsharded_step(fr: FedRound, mesh: Mesh) -> Callable:
     geometry (keyed noise draws excepted, see
     :class:`~blades_tpu.adversaries.update_attacks.NoiseAdversary`).
     Constraint: ``n`` divisible by the mesh size.
+
+    ``malicious_prefix``: elide the dead malicious-lane training (see
+    :func:`_build_dsharded_body`; requires the
+    :func:`elision_client_order` layout, validated once per mask
+    object).
     """
-    return jax.jit(_build_dsharded_body(fr, mesh))
+    body = _build_dsharded_body(fr, mesh, malicious_prefix)
+    f_local = getattr(body, "f_local", 0)
+    return _validated(jax.jit(body), mesh.devices.size, f_local)
 
 
-def dsharded_multi_step(fr: FedRound, mesh: Mesh, num_rounds: int) -> Callable:
+def dsharded_multi_step(fr: FedRound, mesh: Mesh, num_rounds: int,
+                        malicious_prefix: Optional[int] = None) -> Callable:
     """``rounds_per_dispatch`` for the d-sharded path (VERDICT r4 weak
     #5: through round 4 this path forced 1 and paid the per-round
     host-sync tax the streamed path had just eliminated).
@@ -398,7 +519,7 @@ def dsharded_multi_step(fr: FedRound, mesh: Mesh, num_rounds: int) -> Callable:
     ``FedRound.multi_step`` (``split(key, num_rounds)``); metrics come
     back stacked ``(num_rounds, ...)``.
     """
-    body_fn = _build_dsharded_body(fr, mesh)
+    body_fn = _build_dsharded_body(fr, mesh, malicious_prefix)
 
     def multi(state: RoundState, data_x, data_y, lengths, malicious, key):
         def body(st, k):
@@ -407,4 +528,4 @@ def dsharded_multi_step(fr: FedRound, mesh: Mesh, num_rounds: int) -> Callable:
         keys = jax.random.split(key, num_rounds)
         return lax.scan(body, state, keys)
 
-    return jax.jit(multi)
+    return _validated(jax.jit(multi), mesh.devices.size, body_fn.f_local)

@@ -173,13 +173,23 @@ def test_projection_is_dominated_by_the_axis_swap():
 
     proj = project_multichip_rounds_per_sec(
         measured_rps=1.1, n_benign_measured=576,
-        n_target=1000, n_dev=8, d=d)
-    # Comm-free bound: 576 trained-client-rounds/s per chip x 8 chips
-    # over 1000 trained lanes (the d-sharded round trains ALL lanes —
-    # no elision on the client-shard layout).
-    perfect = 1.1 * 576 * 8 / 1000
+        n_target=1000, n_dev=8, d=d, num_malicious=250)
+    # Comm-free bound: 576 trained-client-rounds/s per chip over the
+    # 125 - floor(250/8) = 94 lanes each chip trains under d-sharded
+    # elision (the 250 mod 8 = 2 remainder lanes train in tails).
+    assert proj["trained_lanes_per_chip"] == 94
+    perfect = 1.1 * 576 / 94
     assert proj["rounds_per_sec"] < perfect
     assert proj["rounds_per_sec"] > perfect * 0.5
+
+    # The elision discount only applies under the runtime's own gates:
+    # a non-forging adversary (or f < n_dev) trains every lane.
+    no_forge = project_multichip_rounds_per_sec(
+        measured_rps=1.1, n_benign_measured=576,
+        n_target=1000, n_dev=8, d=d, adversary="SignFlip",
+        num_malicious=250)
+    assert no_forge["trained_lanes_per_chip"] == 125
+    assert no_forge["rounds_per_sec"] < proj["rounds_per_sec"]
     assert proj["dominant_collective"] == "update_matrix_swap"
     assert proj["t_ici_s"] > 0
     # The comm term actually derives from the volumes.

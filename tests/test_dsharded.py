@@ -15,6 +15,7 @@ import numpy as np
 import pytest
 
 from blades_tpu.adversaries import get_adversary, make_malicious_mask
+from blades_tpu.algorithms import get_algorithm_class
 from blades_tpu.core import FedRound, Server, TaskSpec
 from blades_tpu.parallel import make_mesh, shard_federation, shard_map_step
 from blades_tpu.ops import layout as L
@@ -193,6 +194,185 @@ def test_dsharded_multi_round_dispatch_matches_sequential(data):
     for a, b in zip(jax.tree.leaves(st_a.server.params),
                     jax.tree.leaves(st_b.server.params)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_elision_client_order_layout():
+    from blades_tpu.parallel.dsharded import elision_client_order
+
+    # Even split: every chip [1 malicious | 1 benign].
+    order = elision_client_order(16, 8, 8)
+    mal = np.arange(16) < 8  # canonical prefix mask
+    m = mal[order].reshape(8, 2)
+    assert m[:, 0].all() and not m[:, 1:].any()
+    assert sorted(order.tolist()) == list(range(16))
+
+    # Remainder: f=10 over 8 chips -> fl=1 everywhere, the 2 leftover
+    # malicious clients train in the first chips' tails.
+    order = elision_client_order(32, 10, 8)
+    m = (np.arange(32) < 10)[order].reshape(8, 4)
+    assert m[:, 0].all()              # every elided prefix is malicious
+    assert m[:, 1:].sum() == 2        # the remainder trains in tails
+    assert sorted(order.tolist()) == list(range(32))
+
+    with pytest.raises(ValueError, match="divide"):
+        elision_client_order(17, 8, 8)
+
+
+@pytest.mark.parametrize("aggregator,adversary", [
+    ("Median", "ALIE"),
+    ("GeoMed", "IPM"),
+    ("Signguard", "MinMax"),
+])
+def test_dsharded_elision_is_exact(data, aggregator, adversary):
+    """Skipping the dead malicious-lane training on the strided layout
+    must reproduce the full d-sharded round bit-for-bit: forged rows
+    come from benign statistics only and replace whatever the malicious
+    lanes trained.  F=8 over the 8-chip mesh -> one elided lane per
+    chip (f < n_dev would elide nothing)."""
+    from blades_tpu.parallel.dsharded import elision_client_order
+
+    F = 8
+    x, y, ln, _ = data
+    order = jnp.asarray(elision_client_order(N, F, 8))
+    mal = (jnp.arange(N) < F)[order]
+    x, y, ln = x[order], y[order], ln[order]
+    mesh = make_mesh()
+    fr = make_fr(aggregator, adversary=adversary)
+    key = jax.random.PRNGKey(23)
+
+    results = []
+    for prefix in (None, F):
+        st = fr.init(jax.random.PRNGKey(0), N)
+        st, (xs, ys, lns, mals) = shard_federation(mesh, st, (x, y, ln, mal))
+        step = dsharded_step(fr, mesh, malicious_prefix=prefix)
+        for r in range(2):
+            st, m = step(st, xs, ys, lns, mals, jax.random.fold_in(key, r))
+        results.append((st, m))
+    (st_a, m_a), (st_b, m_b) = results
+    for a, b in zip(jax.tree.leaves(st_a.server.params),
+                    jax.tree.leaves(st_b.server.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for k in ("train_loss", "agg_norm", "update_norm_mean"):
+        np.testing.assert_array_equal(np.asarray(m_a[k]), np.asarray(m_b[k]))
+
+
+def test_dsharded_elision_ignored_for_training_attacks(data):
+    """SignFlip trains for real — the prefix hint must not skip it."""
+    from blades_tpu.parallel.dsharded import _build_dsharded_body
+
+    fr = make_fr("Mean", adversary="SignFlip")
+    body = _build_dsharded_body(fr, make_mesh(), malicious_prefix=8)
+    assert body.f_local == 0  # gate: no update forge -> no elision
+    # The forging counterpart DOES elide at the same prefix.
+    fr2 = make_fr("Median", adversary="ALIE")
+    assert _build_dsharded_body(fr2, make_mesh(),
+                                malicious_prefix=8).f_local == 1
+
+
+def test_dsharded_elision_validates_mask(data):
+    x, y, ln, _ = data
+    mesh = make_mesh()
+    fr = make_fr("Median", adversary="ALIE")
+    st = fr.init(jax.random.PRNGKey(0), N)
+    bad_mask = jnp.arange(N) < 8  # contiguous prefix, NOT strided
+    st, (xs, ys, lns, mals) = shard_federation(mesh, st, (x, y, ln, bad_mask))
+    step = dsharded_step(fr, mesh, malicious_prefix=8)
+    with pytest.raises(ValueError, match="elision"):
+        step(st, xs, ys, lns, mals, jax.random.PRNGKey(1))
+
+
+def test_dsharded_elision_composes_with_multi_dispatch(data):
+    """malicious_prefix + rounds_per_dispatch together: the scanned
+    elided rounds must equal sequential elided steps bit-for-bit."""
+    from blades_tpu.parallel.dsharded import (dsharded_multi_step,
+                                              elision_client_order)
+
+    F = 8
+    x, y, ln, _ = data
+    order = jnp.asarray(elision_client_order(N, F, 8))
+    mal = (jnp.arange(N) < F)[order]
+    x, y, ln = x[order], y[order], ln[order]
+    mesh = make_mesh()
+    fr = make_fr("Median", adversary="ALIE")
+    key = jax.random.PRNGKey(29)
+    k = 2
+
+    st_a = fr.init(jax.random.PRNGKey(0), N)
+    st_a, (xs, ys, lns, mals) = shard_federation(mesh, st_a, (x, y, ln, mal))
+    multi = dsharded_multi_step(fr, mesh, k, malicious_prefix=F)
+    st_a, m_a = multi(st_a, xs, ys, lns, mals, key)
+
+    st_b = fr.init(jax.random.PRNGKey(0), N)
+    st_b, _ = shard_federation(mesh, st_b, (x, y, ln, mal))
+    step = dsharded_step(fr, mesh, malicious_prefix=F)
+    for kr in jax.random.split(key, k):
+        st_b, _ = step(st_b, xs, ys, lns, mals, kr)
+    for a, b in zip(jax.tree.leaves(st_a.server.params),
+                    jax.tree.leaves(st_b.server.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_dsharded_elision_through_config():
+    """The Fedavg driver auto-applies the strided layout + elision for a
+    forging adversary on execution='dsharded'."""
+    _, cfg = get_algorithm_class("FEDAVG", return_config=True)
+    cfg.update_from_dict({
+        "dataset_config": {"type": "mnist", "num_clients": 16, "train_bs": 8},
+        "global_model": "mlp",
+        "evaluation_interval": 2,
+        "execution": "dsharded",
+        "num_malicious_clients": 8,
+        "adversary_config": {"type": "ALIE"},
+        "server_config": {"lr": 1.0, "aggregator": {"type": "Median"}},
+    })
+    cfg.resources(num_devices=8)
+    algo = cfg.build()
+    # The mask is strided per chip: [1 malicious | 1 benign] x 8.
+    m = np.asarray(algo.malicious).reshape(8, 2)
+    assert m[:, 0].all() and not m[:, 1].any()
+    r = algo.train()
+    assert np.isfinite(r["train_loss"])
+    assert 0.0 <= algo.evaluate()["test_acc"] <= 1.0
+
+
+def test_checkpoint_realigns_client_state_across_layouts(tmp_path):
+    """A checkpoint saved in natural client order (dense run) resumed
+    on the d-sharded elision layout must remap per-client optimizer
+    state to the permuted rows — not silently pair client i's momentum
+    with client j's data."""
+    from blades_tpu.parallel.dsharded import elision_client_order
+
+    def build(execution, num_devices=None):
+        _, cfg = get_algorithm_class("FEDAVG", return_config=True)
+        cfg.update_from_dict({
+            "dataset_config": {"type": "mnist", "num_clients": 16,
+                               "train_bs": 8},
+            "global_model": "mlp",
+            "evaluation_interval": 100,
+            "execution": execution,
+            "num_malicious_clients": 8,
+            "adversary_config": {"type": "ALIE"},
+            "client_config": {"lr": 0.1, "momentum": 0.9},
+            "server_config": {"lr": 1.0, "aggregator": {"type": "Median"}},
+        })
+        if num_devices:
+            cfg.resources(num_devices=num_devices)
+        return cfg.build()
+
+    a = build("dense")
+    a.train()  # client momentum becomes client-distinct
+    ckpt = a.save_checkpoint(str(tmp_path))
+
+    b = build("dsharded", num_devices=8)
+    b.load_checkpoint(ckpt)
+    order = elision_client_order(16, 8, 8)
+    for src, dst in zip(jax.tree.leaves(a.state.client_opt),
+                        jax.tree.leaves(b.state.client_opt)):
+        np.testing.assert_array_equal(np.asarray(src)[order],
+                                      np.asarray(dst))
+    # And the realigned state trains on.
+    r = b.train()
+    assert np.isfinite(r["train_loss"])
 
 
 def test_dsharded_trains_under_attack(data):
