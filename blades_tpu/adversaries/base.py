@@ -27,11 +27,23 @@ def benign_mean_std(
     updates: jax.Array, malicious: jax.Array
 ) -> Tuple[jax.Array, jax.Array]:
     """Mean and unbiased std over benign rows (torch ``std`` is ddof=1,
-    which is what every reference attack consumes)."""
+    which is what every reference attack consumes).
+
+    Select-masked, not multiply-masked: ``0 * NaN = NaN``, so a
+    malicious lane whose training diverged would otherwise contaminate
+    the BENIGN statistics (and with them the forged rows and the whole
+    round) despite its zero weight — and would make the malicious-lane
+    elision paths, which never compute the dead rows, inequivalent in
+    exactly that corner.  ``where`` keeps non-finite malicious values
+    out entirely, so forged rows depend on benign lanes alone on every
+    path.
+    """
     w = (~malicious).astype(updates.dtype)
     nb = jnp.maximum(w.sum(), 1.0)
-    mean = (updates * w[:, None]).sum(axis=0) / nb
-    var = ((updates - mean) ** 2 * w[:, None]).sum(axis=0) / jnp.maximum(nb - 1.0, 1.0)
+    xs = jnp.where(malicious[:, None], 0.0, updates)
+    mean = xs.sum(axis=0) / nb
+    var = (jnp.where(malicious[:, None], 0.0, (updates - mean) ** 2)
+           .sum(axis=0) / jnp.maximum(nb - 1.0, 1.0))
     return mean, jnp.sqrt(var)
 
 

@@ -242,8 +242,10 @@ class Fedavg:
         if not f or not _adv_forges(self.fed_round.adversary):
             return None
         # floor(f/n_dev) lanes elide per chip; below one per chip there
-        # is nothing to skip and the permutation would be pointless.
-        if cfg.num_clients % cfg.num_devices or f < cfg.num_devices:
+        # is nothing to skip, and an all-malicious federation has no
+        # benign lanes to train (elision_client_order requires f < n).
+        if (cfg.num_clients % cfg.num_devices or f < cfg.num_devices
+                or f >= cfg.num_clients):
             return None
         return f
 

@@ -231,11 +231,19 @@ def project_multichip_rounds_per_sec(
     # round elides floor(f/n_dev) malicious lanes per chip, but ONLY
     # under the same gates the runtime applies
     # (Fedavg._dsharded_elision_prefix): an update-FORGING adversary
-    # (training-side attacks train for real), f >= n_dev, and n
-    # divisible by the mesh; otherwise every lane trains.
-    forging = adversary in ("ALIE", "IPM", "Noise", "MinMax", "Adaptive",
-                            "SignGuard", "Attackclippedclustering")
-    elides = (forging and num_malicious >= n_dev
+    # (training-side attacks train for real), n_dev <= f < n, and n
+    # divisible by the mesh; otherwise every lane trains.  Forging is
+    # the runtime's own predicate — the registered class overriding
+    # on_updates_ready — so a new adversary cannot drift the model.
+    if adversary is None:
+        forging = False
+    else:
+        from blades_tpu.adversaries import ADVERSARIES
+        from blades_tpu.adversaries.base import Adversary
+
+        cls = ADVERSARIES[adversary]
+        forging = cls.on_updates_ready is not Adversary.on_updates_ready
+    elides = (forging and n_dev <= num_malicious < n_target
               and n_target % n_dev == 0)
     trained_per_chip = (-(-n_target // n_dev)
                         - (num_malicious // n_dev if elides else 0))

@@ -63,6 +63,20 @@ def test_benign_mean_std_matches_numpy(updates, malicious):
     assert np.allclose(std, ref.std(axis=0, ddof=1), atol=1e-5)
 
 
+def test_benign_mean_std_immune_to_nonfinite_malicious_rows(updates,
+                                                            malicious):
+    """A malicious lane whose training diverged must not contaminate
+    the BENIGN statistics through the mask (0 * NaN = NaN under a
+    multiply-mask) — this is also what keeps the malicious-lane elision
+    paths (which never compute the dead rows) bit-equal to the full
+    round in the divergence corner."""
+    clean_mean, clean_std = benign_mean_std(updates, malicious)
+    poisoned = updates.at[0].set(jnp.nan).at[1].set(jnp.inf)
+    mean, std = benign_mean_std(poisoned, malicious)
+    np.testing.assert_array_equal(np.asarray(mean), np.asarray(clean_mean))
+    np.testing.assert_array_equal(np.asarray(std), np.asarray(clean_std))
+
+
 def test_alie_forges_mean_plus_zmax_std(updates, malicious):
     adv = ALIEAdversary(num_clients=N, num_byzantine=F)
     out = adv.on_updates_ready(updates, malicious, KEY, aggregator=Mean())
