@@ -90,6 +90,10 @@ class FedavgConfig:
         # failure detection / elastic recovery (core/health.py): zero
         # non-finite client lanes, skip non-finite server updates
         self.health_check: bool = False
+        # defense forensics (obs subsystem): per-lane aggregator telemetry
+        # + Byzantine detection precision/recall/FPR emitted from inside
+        # the jitted round; dense single-chip execution only
+        self.forensics: bool = False
         # server root-dataset size for trust-bootstrapped aggregators (FLTrust)
         self.fltrust_root_size: int = 100
         # resources
@@ -172,6 +176,11 @@ class FedavgConfig:
         """In-round failure detection / elastic recovery (core/health.py);
         the trial-level analogue is ``run_experiments(max_failures=)``."""
         return self._set(health_check=health_check)
+
+    def observability(self, *, forensics=None):
+        """Defense forensics: per-lane aggregator diagnostics + Byzantine
+        detection precision/recall/FPR per round (obs subsystem)."""
+        return self._set(forensics=forensics)
 
     # -- dict shim (ref: algorithm_config.py:253-293,360-379) ----------------
 
@@ -284,6 +293,21 @@ class FedavgConfig:
             # rounds_per_dispatch > 1 chains k streamed rounds through the
             # dispatch pipeline with no host sync between them
             # (parallel/streamed.streamed_multi_step).
+        if self.forensics:
+            if self.execution in ("streamed", "dsharded"):
+                raise ValueError(
+                    "forensics per-lane telemetry is only formulated for the "
+                    "dense round; the streamed/d-sharded paths never "
+                    "materialise the per-lane decisions it reports — use "
+                    "execution='dense' (or 'auto' within the dense budget) "
+                    "or disable forensics"
+                )
+            if self.num_devices and self.num_devices > 1:
+                raise ValueError(
+                    "forensics is single-chip for now: per-lane diagnostics "
+                    "under shard_map would shard the lane axis — run the "
+                    "forensic pass without num_devices, or disable forensics"
+                )
         if str(self.update_dtype) not in ("bfloat16", "float32"):
             raise ValueError(
                 f"update_dtype must be 'bfloat16' or 'float32', got "
@@ -389,6 +413,7 @@ class FedavgConfig:
             # shard_federation) are sliced out of forging/aggregation.
             num_clients=self.num_clients,
             health_check=self.health_check,
+            forensics=self.forensics,
         )
 
     def build(self):

@@ -148,6 +148,14 @@ class Fedavg:
                 self._step = sharded_step(self.fed_round, self.mesh, donate=False)
             self._evaluate = sharded_evaluate(self.fed_round, self.mesh)
         elif self._use_streamed():
+            if cfg.forensics:
+                raise ValueError(
+                    "forensics needs the dense round but 'auto' execution "
+                    "resolved to streaming (the dense (n, d) matrix would "
+                    f"strain HBM at num_clients={cfg.num_clients}); shrink "
+                    "the federation for the forensic pass or disable "
+                    "forensics"
+                )
             from blades_tpu.parallel.streamed import streamed_step
 
             # With bf16 compute the loss casts inputs down anyway — store
@@ -347,11 +355,16 @@ class Fedavg:
                 self.state, *self._train_arrays, self.malicious, round_key
             )
             # Concrete fetches inside the timer: block_until_ready alone can
-            # return early through remote-execution tunnels.
-            metrics = {
-                k: float(v[-1] if getattr(v, "ndim", 0) else v)
-                for k, v in raw_metrics.items()
-            }
+            # return early through remote-execution tunnels.  "lane_" keys
+            # are per-lane forensics vectors ((n,), stacked to (rounds, n)
+            # under rounds_per_dispatch) — kept whole, last round reported.
+            metrics, lanes = {}, {}
+            for k, v in raw_metrics.items():
+                if k.startswith("lane_"):
+                    arr = jax.device_get(v)
+                    lanes[k[len("lane_"):]] = arr[-1] if arr.ndim > 1 else arr
+                else:
+                    metrics[k] = float(v[-1] if getattr(v, "ndim", 0) else v)
         self._iteration += self._chunk
         self._rounds_since_eval += self._chunk
         result = {
@@ -361,12 +374,24 @@ class Fedavg:
             "update_norm_mean": metrics["update_norm_mean"],
             "timers": self.timers.summary(),
         }
-        if self.config.health_check:  # failure-detection metrics (health.py)
+        if self.config.health_check or self.config.forensics:
             # Reduce over the dispatch chunk, not just its last round: a
-            # bad round mid-chunk must surface (sum of per-round unhealthy
-            # lane counts; ok only if EVERY round was ok).
+            # lane that went non-finite mid-chunk must surface even if it
+            # recovered by the last round (sum of per-round unhealthy lane
+            # counts; both opt-in modes emit the same per-round metric).
             result["num_unhealthy"] = int(jnp.sum(raw_metrics["num_unhealthy"]))
+        if self.config.health_check:  # failure-detection metrics (health.py)
+            # ok only if EVERY round in the chunk was ok.
             result["round_ok"] = bool(jnp.all(raw_metrics["round_ok"]))
+        if self.config.forensics:  # defense forensics (obs subsystem)
+            for k in ("byz_precision", "byz_recall", "byz_fpr"):
+                result[k] = metrics[k]
+            result["num_flagged"] = int(metrics["num_flagged"])
+            result["lane_forensics"] = {
+                "benign_mask": [bool(b > 0.5) for b in lanes["benign_mask"]],
+                "healthy": [bool(h > 0.5) for h in lanes["healthy"]],
+                "scores": [float(s) for s in lanes["scores"]],
+            }
         # Rounds-since-last-eval cadence: robust to rounds_per_dispatch not
         # dividing evaluation_interval (a modulo test would then never fire).
         if self.config.evaluation_interval and (
@@ -388,6 +413,40 @@ class Fedavg:
                 "test_acc_top3": float(ev["test_acc_top3"]),
             }
         return dict(self._last_eval)
+
+    # -- compiled-cost analysis (obs subsystem) ------------------------------
+
+    _COST_KEYS = ("flops", "bytes accessed", "transcendentals")
+
+    def cost_analysis(self) -> Optional[Dict]:
+        """FLOPs / bytes of ONE compiled training dispatch, from XLA's own
+        compiler estimate (``lower().compile().cost_analysis()``) — the
+        hardware-speed denominator every BENCH MFU number needs.  Memoized
+        (lowering re-traces; on backends without a shared AOT executable
+        cache that is one extra compile per trial).  ``None`` when the
+        executable or backend will not report costs — never raises.
+        """
+        if hasattr(self, "_cost_analysis"):
+            return self._cost_analysis
+        cost = None
+        try:
+            lowered = self._step.lower(
+                self.state, *self._train_arrays, self.malicious,
+                jax.random.PRNGKey(0),
+            )
+            ca = lowered.compile().cost_analysis()
+            if isinstance(ca, (list, tuple)):  # older jax: one per device
+                ca = ca[0] if ca else None
+            if ca:
+                cost = {
+                    k.replace(" ", "_"): float(ca[k])
+                    for k in self._COST_KEYS
+                    if isinstance(ca.get(k), (int, float))
+                } or None
+        except Exception:
+            cost = None
+        self._cost_analysis = cost
+        return cost
 
     # -- checkpointing (full state; fixes ref gap SURVEY.md §5) --------------
 

@@ -87,6 +87,14 @@ class FedRound:
     # ``num_unhealthy``/``round_ok`` metrics.  Costs one extra pass over
     # the update matrix, so opt-in.
     health_check: bool = False
+    # Defense forensics (obs subsystem): aggregate via the aggregator's
+    # diagnose() path and emit per-lane telemetry — the benign/trim mask,
+    # per-lane scores, the lane-health mask — plus Byzantine detection
+    # precision/recall/FPR scored against the true malicious mask, all as
+    # extra jit outputs.  False keeps the round program LITERALLY
+    # unchanged (Python-level branch on static config); the diagnose()
+    # aggregate shares __call__'s trace, so numerics match either way.
+    forensics: bool = False
 
     # -- construction -------------------------------------------------------
 
@@ -166,6 +174,12 @@ class FedRound:
             from blades_tpu.core.health import sanitize_updates
 
             updates, healthy = sanitize_updates(updates)
+        elif self.forensics:
+            # Non-destructive probe of sanitize_updates' predicate at the
+            # SAME point in the round (pre-DP, pre-forge), so the
+            # num_unhealthy metric means the same thing whether or not
+            # health_check is recovering the lanes it counts.
+            healthy = jnp.isfinite(updates).all(axis=-1)
         updates = self.apply_dp(updates, k_dp)
 
         if self.adversary is not None and hasattr(self.adversary, "on_updates_ready"):
@@ -178,9 +192,15 @@ class FedRound:
         trusted_update = self.compute_trusted_update(
             state.server.params, jax.random.fold_in(k_agg, 1)
         )
-        server, agg = self.server.step(
-            state.server, updates, key=k_agg, trusted_update=trusted_update
-        )
+        diag = None
+        if self.forensics:
+            server, agg, diag = self.server.step_diag(
+                state.server, updates, key=k_agg, trusted_update=trusted_update
+            )
+        else:
+            server, agg = self.server.step(
+                state.server, updates, key=k_agg, trusted_update=trusted_update
+            )
         benign = (~malicious).astype(jnp.float32)
         train_loss = (losses * benign).sum() / jnp.maximum(benign.sum(), 1.0)
         metrics = {
@@ -196,6 +216,21 @@ class FedRound:
             server = guard_server_state(ok, server, state.server)
             metrics["num_unhealthy"] = (~healthy).sum()
             metrics["round_ok"] = ok
+        if self.forensics:
+            from blades_tpu.obs.forensics import detection_metrics
+
+            # Lane-health mask: sanitize_updates' mask when health_check
+            # ran, else the probe taken above at the same point — surfaced
+            # instead of silently zeroed/ignored.
+            healthy_mask = healthy
+            metrics.update(detection_metrics(diag["benign_mask"], malicious))
+            if not self.health_check:
+                metrics["num_unhealthy"] = (~healthy_mask).sum()
+            # Per-lane bundle (prefix "lane_"): hosts split these from the
+            # scalar metrics.  f32 so lax.scan stacking stays uniform.
+            metrics["lane_benign_mask"] = diag["benign_mask"].astype(jnp.float32)
+            metrics["lane_scores"] = diag["scores"].astype(jnp.float32)
+            metrics["lane_healthy"] = healthy_mask.astype(jnp.float32)
         return RoundState(server=server, client_opt=client_opt), metrics
 
     def multi_step(

@@ -147,6 +147,41 @@ class Server:
         row of the matrix; passing a plain client matrix to FLTrust would
         make the last *client* the root of trust, so that is rejected.
         """
+        updates = self._with_trusted_row(updates, trusted_update)
+        agg, agg_state = self.aggregator(updates, state.agg_state, key=key)
+        return self.apply_aggregate(state, agg, agg_state), agg
+
+    def step_diag(
+        self,
+        state: ServerState,
+        updates: jax.Array,
+        *,
+        key: Optional[jax.Array] = None,
+        trusted_update: Optional[jax.Array] = None,
+    ) -> Tuple[ServerState, jax.Array, dict]:
+        """:meth:`step` plus the aggregator's per-lane diagnostics bundle
+        (see ``Aggregator.diagnose``) — ``(new_state, aggregate, diag)``.
+        The diag arrays cover the CLIENT lanes of ``updates`` (FLTrust's
+        appended trusted row judges, it is not judged), so they align with
+        the round's malicious/health masks.
+        """
+        n_clients = updates.shape[0]
+        updates = self._with_trusted_row(updates, trusted_update)
+        agg, agg_state, diag = self.aggregator.diagnose(
+            updates, state.agg_state, key=key
+        )
+        if diag["benign_mask"].shape[0] != n_clients:
+            raise ValueError(
+                f"{self.aggregator.name} diagnostics cover "
+                f"{diag['benign_mask'].shape[0]} lanes for {n_clients} "
+                "client rows — per-lane forensics must align with the "
+                "client axis"
+            )
+        return self.apply_aggregate(state, agg, agg_state), agg, diag
+
+    def _with_trusted_row(
+        self, updates: jax.Array, trusted_update: Optional[jax.Array]
+    ) -> jax.Array:
         if getattr(self.aggregator, "expects_trusted_row", False):
             if trusted_update is None:
                 raise ValueError(
@@ -155,8 +190,7 @@ class Server:
                     "row would silently become the root of trust"
                 )
             updates = jnp.concatenate([updates, trusted_update[None, :]], axis=0)
-        agg, agg_state = self.aggregator(updates, state.agg_state, key=key)
-        return self.apply_aggregate(state, agg, agg_state), agg
+        return updates
 
     def apply_aggregate(
         self, state: ServerState, agg: jax.Array, agg_state: Any = None

@@ -1,0 +1,29 @@
+"""Observability: defense forensics + structured metrics pipeline.
+
+Two halves (ROADMAP: the metrics/tracing layer before further perf work):
+
+- **on-device** (:mod:`blades_tpu.obs.forensics`): every aggregator's
+  per-lane keep/trim/trust decision, scored against the true
+  malicious-lane mask inside the jitted round — detection
+  precision/recall/FPR as device scalars, zero overhead when disabled
+  (the diagnostics outputs are dead-code-eliminated by XLA).
+- **host-side** (:mod:`blades_tpu.obs.metrics`, :mod:`~.schema`): a
+  ``MetricsLogger`` with JSONL / CSV / stdout sinks emitting one
+  schema-validated record per round, wired into
+  :func:`blades_tpu.tune.sweep.run_experiments`.
+"""
+
+from blades_tpu.obs.forensics import detection_metrics  # noqa: F401
+from blades_tpu.obs.metrics import (  # noqa: F401
+    CsvSink,
+    JsonlSink,
+    MetricsLogger,
+    Sink,
+    StdoutSink,
+)
+from blades_tpu.obs.schema import (  # noqa: F401
+    ROUND_RECORD_FIELDS,
+    SchemaError,
+    validate_jsonl,
+    validate_record,
+)

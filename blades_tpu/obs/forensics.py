@@ -1,0 +1,57 @@
+"""Defense forensics: Byzantine-detection quality as device scalars.
+
+Every robust aggregator makes a per-lane keep/trim/trust decision each
+round (see ``Aggregator.diagnose`` in :mod:`blades_tpu.ops.aggregators`).
+Against the fault-injection ground truth — the ``malicious`` lane mask the
+round already carries — that decision is a binary classifier, and its
+confusion matrix is computable INSIDE the jitted round for free:
+``benign_mask`` says who the defense kept, ``malicious`` says who it
+should have dropped.
+
+Scoring convention: a lane OUTSIDE ``benign_mask`` counts as *flagged*
+(predicted Byzantine).  Coordinate-wise aggregators that never exclude a
+whole lane (Mean, Median, GeoMed) flag nobody and honestly score
+recall 0 — that IS the finding ("this defense cannot attribute blame"),
+not a metrics bug.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+
+def detection_metrics(
+    benign_mask: jax.Array, malicious: jax.Array
+) -> Dict[str, jax.Array]:
+    """Confusion-matrix scalars for one round's lane decision.
+
+    Args:
+        benign_mask: ``(n,)`` bool — lanes the aggregator kept.
+        malicious: ``(n,)`` bool — ground-truth Byzantine lanes.
+
+    Returns:
+        dict of f32/int32 device scalars:
+        ``byz_precision`` — of the flagged lanes, fraction truly malicious
+        (1.0 when nothing is flagged: no false alarms);
+        ``byz_recall`` — of the malicious lanes, fraction flagged
+        (1.0 when there are no malicious lanes to catch);
+        ``byz_fpr`` — fraction of benign lanes falsely flagged;
+        ``num_flagged`` — int32 count of flagged lanes.
+    """
+    flagged = ~benign_mask.astype(bool)
+    mal = malicious.astype(bool)
+    f32 = jnp.float32
+    tp = (flagged & mal).sum().astype(f32)
+    fp = (flagged & ~mal).sum().astype(f32)
+    n_flagged = tp + fp
+    n_mal = mal.sum().astype(f32)
+    n_benign = (~mal).sum().astype(f32)
+    return {
+        "byz_precision": jnp.where(n_flagged > 0, tp / jnp.maximum(n_flagged, 1.0), 1.0),
+        "byz_recall": jnp.where(n_mal > 0, tp / jnp.maximum(n_mal, 1.0), 1.0),
+        "byz_fpr": fp / jnp.maximum(n_benign, 1.0),
+        "num_flagged": n_flagged.astype(jnp.int32),
+    }

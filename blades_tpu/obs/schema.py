@@ -1,0 +1,193 @@
+"""Round-record schema for the structured metrics pipeline.
+
+One JSONL record per FL round (the Tune ``result.json`` row enriched with
+defense forensics).  The schema is deliberately STRICT — unknown top-level
+keys are rejected — so that adding a new metric without registering it
+here fails a fast tier-1 test instead of silently drifting the on-disk
+format every downstream consumer (visualize, BENCH graders, dashboards)
+parses.
+
+Hand-rolled on purpose: the image has no ``jsonschema`` and the record
+shape is flat enough that a table of ``name -> (types, required)`` plus
+two nested checks (``timers``, ``lane_forensics``) covers it.
+
+Validate a stream from the CLI::
+
+    python -m blades_tpu.obs.schema path/to/metrics.jsonl
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+_NUM = (int, float)
+
+# name -> (allowed value types, required)
+ROUND_RECORD_FIELDS: Dict[str, Tuple[tuple, bool]] = {
+    # identity
+    "experiment": ((str,), True),
+    "trial": ((str,), True),
+    "training_iteration": ((int,), True),
+    # lane knobs (tune/lanes.py stamps each laned row with its overrides)
+    "seed": ((int,), False),
+    "client_lr": (_NUM, False),
+    "server_lr": (_NUM, False),
+    "dp_epsilon": (_NUM, False),
+    "dp_clip_threshold": (_NUM, False),
+    "dp_noise_factor": (_NUM, False),
+    "adversary_scale": (_NUM, False),
+    # training metrics (core/round.py).  Optional: the sweep runner logs
+    # whatever the trainable returns, and a custom/mock trainable may not
+    # report a loss — strictness lives in the unknown-key rejection.
+    "train_loss": (_NUM, False),
+    "agg_norm": (_NUM, False),
+    "update_norm_mean": (_NUM, False),
+    # evaluation (core/round.py::evaluate)
+    "test_loss": (_NUM, False),
+    "test_acc": (_NUM, False),
+    "test_acc_top3": (_NUM, False),
+    # health (core/health.py)
+    "num_unhealthy": ((int,), False),
+    "round_ok": ((bool,), False),
+    # defense forensics (obs/forensics.py)
+    "byz_precision": (_NUM, False),
+    "byz_recall": (_NUM, False),
+    "byz_fpr": (_NUM, False),
+    "num_flagged": ((int,), False),
+    "lane_forensics": ((dict,), False),
+    # host-side timings (utils/timers.py)
+    "timers": ((dict,), False),
+}
+
+# lane_forensics sub-keys -> allowed element types
+_LANE_FIELDS: Dict[str, tuple] = {
+    "benign_mask": (bool,),
+    "healthy": (bool,),
+    "scores": _NUM,
+}
+
+
+class SchemaError(ValueError):
+    """A metrics record that does not match :data:`ROUND_RECORD_FIELDS`."""
+
+
+def _type_ok(value: Any, types: tuple) -> bool:
+    # bool is an int subclass; only accept it where bool is explicitly
+    # allowed (a True leaking into train_loss is a bug, not a number).
+    if isinstance(value, bool):
+        return bool in types
+    return isinstance(value, types)
+
+
+def validate_record(record: Any) -> Dict[str, Any]:
+    """Validate one round record; returns it unchanged or raises
+    :class:`SchemaError` naming every violation at once."""
+    if not isinstance(record, dict):
+        raise SchemaError(f"record must be a dict, got {type(record).__name__}")
+    problems: List[str] = []
+    unknown = sorted(set(record) - set(ROUND_RECORD_FIELDS))
+    if unknown:
+        problems.append(
+            f"unknown keys {unknown} (register new metrics in "
+            "blades_tpu/obs/schema.py::ROUND_RECORD_FIELDS)"
+        )
+    for name, (types, required) in ROUND_RECORD_FIELDS.items():
+        if name not in record:
+            if required:
+                problems.append(f"missing required key {name!r}")
+            continue
+        if not _type_ok(record[name], types):
+            problems.append(
+                f"{name!r} must be {'/'.join(t.__name__ for t in types)}, "
+                f"got {type(record[name]).__name__}"
+            )
+    lanes = record.get("lane_forensics")
+    if isinstance(lanes, dict):
+        problems.extend(_validate_lanes(lanes))
+    timers = record.get("timers")
+    if timers is not None and isinstance(timers, dict):
+        for phase, stats in timers.items():
+            if not isinstance(stats, dict):
+                problems.append(f"timers[{phase!r}] must be a dict")
+    if problems:
+        raise SchemaError("; ".join(problems))
+    return record
+
+
+def _validate_lanes(lanes: Dict[str, Any]) -> List[str]:
+    problems: List[str] = []
+    unknown = sorted(set(lanes) - set(_LANE_FIELDS))
+    if unknown:
+        problems.append(f"unknown lane_forensics keys {unknown}")
+    lengths = set()
+    for name, types in _LANE_FIELDS.items():
+        vals = lanes.get(name)
+        if vals is None:
+            continue
+        if not isinstance(vals, list):
+            problems.append(f"lane_forensics[{name!r}] must be a list")
+            continue
+        lengths.add(len(vals))
+        if not all(_type_ok(v, types) for v in vals):
+            problems.append(
+                f"lane_forensics[{name!r}] elements must be "
+                f"{'/'.join(t.__name__ for t in types)}"
+            )
+    if len(lengths) > 1:
+        problems.append(
+            f"lane_forensics arrays disagree on lane count: {sorted(lengths)}"
+        )
+    return problems
+
+
+def validate_jsonl(
+    path, max_errors: Optional[int] = None
+) -> Tuple[int, List[Tuple[int, str]]]:
+    """Validate every line of a JSONL metrics stream.
+
+    Returns ``(num_valid, errors)`` where ``errors`` is a list of
+    ``(1-based line number, message)``.  A torn final line (a killed run)
+    is reported like any other violation; its message is a
+    ``json.JSONDecodeError`` string, distinguishable from the
+    :class:`SchemaError` messages validation produces.
+    """
+    errors: List[Tuple[int, str]] = []
+    num_valid = 0
+    with open(path) as f:
+        for lineno, line in enumerate(f, start=1):
+            if not line.strip():
+                continue
+            try:
+                validate_record(json.loads(line))
+                num_valid += 1
+            except (json.JSONDecodeError, SchemaError) as exc:
+                errors.append((lineno, str(exc)))
+                if max_errors is not None and len(errors) >= max_errors:
+                    break
+    return num_valid, errors
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="blades_tpu.obs.schema",
+        description="validate a metrics.jsonl stream against the round-record schema",
+    )
+    p.add_argument("paths", nargs="+")
+    args = p.parse_args(argv)
+    rc = 0
+    for path in args.paths:
+        num_valid, errors = validate_jsonl(path)
+        print(f"{path}: {num_valid} valid record(s), {len(errors)} error(s)")
+        for lineno, msg in errors:
+            print(f"  line {lineno}: {msg}")
+            rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

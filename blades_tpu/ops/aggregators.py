@@ -13,6 +13,18 @@ clustering by the fixed-shape programs in :mod:`blades_tpu.ops.clustering`.
 
 Aggregator instances are hashable static config — pass them as
 ``static_argnums`` / close over them under ``jax.jit``.
+
+**Defense forensics** (obs subsystem): every aggregator also exposes
+``diagnose(updates, state, key=) -> (aggregate, new_state, diag)`` where
+``diag`` is a per-lane diagnostics bundle — ``benign_mask`` (``(n,)``
+bool: lanes the defense kept) and ``scores`` (``(n,)`` f32: the
+aggregator's native per-lane statistic — Krum distance sums, DnC
+projection energies, SignGuard/clipping clip factors, FLTrust cosines,
+trimmed-mean trim fractions).  The aggregate returned by ``diagnose`` is
+computed by the SAME trace as ``__call__`` — selection aggregators derive
+both from one shared selection — so enabling diagnostics cannot change
+numerics, and when the diag outputs are unused XLA dead-code-eliminates
+them (zero overhead when disabled).
 """
 
 from __future__ import annotations
@@ -27,11 +39,30 @@ from jax import lax
 from blades_tpu.ops import clustering, masked
 
 AggState = Any
+LaneDiag = dict
+
+
+def lane_diag(benign_mask: jax.Array, scores: jax.Array) -> LaneDiag:
+    """Per-lane diagnostics bundle: ``benign_mask`` (n,) bool (lanes the
+    defense kept), ``scores`` (n,) f32 (the aggregator's native per-lane
+    statistic; polarity is per-aggregator and documented on each)."""
+    return {
+        "benign_mask": benign_mask.astype(bool),
+        "scores": scores.astype(jnp.float32),
+    }
+
+
+def _keep_all_diag(updates: jax.Array, scores: Optional[jax.Array] = None) -> LaneDiag:
+    n = updates.shape[0]
+    if scores is None:
+        scores = jnp.zeros((n,), jnp.float32)
+    return lane_diag(jnp.ones((n,), bool), scores)
 
 
 @dataclasses.dataclass(frozen=True)
 class Aggregator:
-    """Base class: stateless, keyless aggregators override ``aggregate``."""
+    """Base class: stateless, keyless aggregators override ``aggregate``
+    (and ``aggregate_diag`` when they have a per-lane story to tell)."""
 
     def init(self, num_params: int, num_clients: int) -> AggState:
         del num_params, num_clients
@@ -39,6 +70,15 @@ class Aggregator:
 
     def aggregate(self, updates: jax.Array) -> jax.Array:
         raise NotImplementedError
+
+    def aggregate_diag(self, updates: jax.Array) -> Tuple[jax.Array, LaneDiag]:
+        """``(aggregate, diag)``.  Default: keep-all mask with the lane's
+        L2 distance to the aggregate as score — honest for aggregators
+        that never exclude a lane (Mean/Median/GeoMed)."""
+        agg = self.aggregate(updates)
+        return agg, _keep_all_diag(
+            updates, jnp.linalg.norm(updates - agg[None, :], axis=1)
+        )
 
     def __call__(
         self,
@@ -49,6 +89,21 @@ class Aggregator:
     ) -> Tuple[jax.Array, AggState]:
         del key
         return self.aggregate(updates), state
+
+    def diagnose(
+        self,
+        updates: jax.Array,
+        state: AggState = (),
+        *,
+        key: Optional[jax.Array] = None,
+    ) -> Tuple[jax.Array, AggState, LaneDiag]:
+        """``__call__`` plus the per-lane diagnostics bundle.  The
+        aggregate comes from the same trace as ``__call__`` (selection
+        aggregators compute both from one shared selection), so the two
+        entry points cannot diverge numerically."""
+        del key
+        agg, diag = self.aggregate_diag(updates)
+        return agg, state, diag
 
     @property
     def name(self) -> str:
@@ -111,6 +166,20 @@ class Trimmedmean(Aggregator):
         s = jnp.sort(updates, axis=0)
         return s[k : n - k].mean(axis=0)
 
+    def aggregate_diag(self, updates: jax.Array) -> Tuple[jax.Array, LaneDiag]:
+        """Diag: score = per-lane TRIM FRACTION (share of coordinates this
+        lane contributed to the dropped top-k/bottom-k, 2k/n for a
+        perfectly average lane, -> 1 for a lane trimmed everywhere);
+        benign_mask = trim fraction < 0.5 (lane kept on a majority of
+        coordinates).  The aggregate reuses :meth:`aggregate` unchanged —
+        including its pallas fast path — so diagnostics cannot perturb it."""
+        agg = self.aggregate(updates)
+        n, k = updates.shape[0], self.num_excluded
+        ranks = jnp.argsort(jnp.argsort(updates, axis=0), axis=0)
+        trimmed = (ranks < k) | (ranks >= n - k)
+        frac = trimmed.mean(axis=1, dtype=jnp.float32)
+        return agg, lane_diag(frac < 0.5, frac)
+
 
 @dataclasses.dataclass(frozen=True)
 class GeoMed(Aggregator):
@@ -171,13 +240,10 @@ class DnC(Aggregator):
     num_iters: int = 5
     filter_frac: float = 1.0
 
-    def __call__(
-        self,
-        updates: jax.Array,
-        state: AggState = (),
-        *,
-        key: Optional[jax.Array] = None,
-    ) -> Tuple[jax.Array, AggState]:
+    def _select(self, updates: jax.Array, key: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        """Shared selection: ``(benign mask (n,), mean projection score
+        (n,))`` — the single trace both ``__call__`` and ``diagnose``
+        aggregate from."""
         if key is None:
             raise ValueError(
                 "DnC requires a PRNG key: a fixed coordinate subsample would "
@@ -203,12 +269,34 @@ class DnC(Aggregator):
             v = jnp.linalg.svd(centered, full_matrices=False)[2][0]
             s = (centered @ v) ** 2
             rank = jnp.argsort(jnp.argsort(s))
-            return rank < keep  # (n,) benign this iteration
+            return rank < keep, s  # (n,) benign this iteration + scores
 
         keys = jax.random.split(key, self.num_iters)
-        benign_iters = jax.vmap(one_iter)(keys)  # (num_iters, n)
-        benign = jnp.any(benign_iters, axis=0)
+        benign_iters, scores_iters = jax.vmap(one_iter)(keys)  # (num_iters, n)
+        return jnp.any(benign_iters, axis=0), scores_iters.mean(axis=0)
+
+    def __call__(
+        self,
+        updates: jax.Array,
+        state: AggState = (),
+        *,
+        key: Optional[jax.Array] = None,
+    ) -> Tuple[jax.Array, AggState]:
+        benign, _ = self._select(updates, key)
         return masked.masked_mean(updates, benign), state
+
+    def diagnose(
+        self,
+        updates: jax.Array,
+        state: AggState = (),
+        *,
+        key: Optional[jax.Array] = None,
+    ) -> Tuple[jax.Array, AggState, LaneDiag]:
+        """Diag: score = squared projection on the top singular vector,
+        averaged over the ``num_iters`` subsamples (higher = more
+        outlying); benign_mask = the union keep-set the mean runs over."""
+        benign, scores = self._select(updates, key)
+        return masked.masked_mean(updates, benign), state, lane_diag(benign, scores)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -230,7 +318,10 @@ class Multikrum(Aggregator):
     num_byzantine: int
     k: int = 1
 
-    def aggregate(self, updates: jax.Array) -> jax.Array:
+    def aggregate_diag(self, updates: jax.Array) -> Tuple[jax.Array, LaneDiag]:
+        """Diag: score = the Krum score itself (sum of the ``n - f - 2``
+        smallest squared distances; higher = more isolated);
+        benign_mask = the ``k`` lowest-scoring lanes the mean runs over."""
         n = updates.shape[0]
         f = self.num_byzantine
         if 2 * f + 2 > n:
@@ -244,7 +335,11 @@ class Multikrum(Aggregator):
         nearest = jnp.sort(d2, axis=1)[:, : n - f - 2]
         scores = nearest.sum(axis=1)
         rank = jnp.argsort(jnp.argsort(scores))
-        return masked.masked_mean(updates, rank < self.k)
+        mask = rank < self.k
+        return masked.masked_mean(updates, mask), lane_diag(mask, scores)
+
+    def aggregate(self, updates: jax.Array) -> jax.Array:
+        return self.aggregate_diag(updates)[0]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -281,6 +376,20 @@ class Centeredclipping(Aggregator):
         momentum = lax.fori_loop(0, self.n_iter, body, momentum)
         return momentum, momentum
 
+    def diagnose(
+        self,
+        updates: jax.Array,
+        state: AggState = (),
+        *,
+        key: Optional[jax.Array] = None,
+    ) -> Tuple[jax.Array, AggState, LaneDiag]:
+        """Diag: score = each lane's deviation norm from the FINAL center
+        (the quantity the clip tests); benign_mask = lanes within ``tau``
+        of it (lanes outside had their influence clipped)."""
+        agg, new_state = self(updates, state, key=key)
+        dev_norm = jnp.linalg.norm(updates - agg[None, :], axis=1)
+        return agg, new_state, lane_diag(dev_norm <= self.tau, dev_norm)
+
 
 @dataclasses.dataclass(frozen=True)
 class Signguard(Aggregator):
@@ -305,7 +414,11 @@ class Signguard(Aggregator):
         if self.linkage not in ("average", "single"):
             raise ValueError(f"unsupported linkage {self.linkage}")
 
-    def aggregate(self, updates: jax.Array) -> jax.Array:
+    def aggregate_diag(self, updates: jax.Array) -> Tuple[jax.Array, LaneDiag]:
+        """Diag: score = the per-lane CLIP FACTOR ``min(1, M/||u_i||)``
+        (1 = untouched, -> 0 = heavily clipped); benign_mask = the
+        norm-band ∩ majority-sign-cluster survivors the reduction runs
+        over."""
         norms = jnp.linalg.norm(updates, axis=1)
         M = jnp.median(norms)
         clipped = masked.clip_rows_to_norm(updates, M)
@@ -314,8 +427,14 @@ class Signguard(Aggregator):
         s2 = clustering.kmeans_majority(clustering.sign_features(clipped))
         mask = s1 & s2
         if self.agg == "mean":
-            return masked.masked_mean(clipped, mask)
-        return masked.masked_median(clipped, mask)
+            agg = masked.masked_mean(clipped, mask)
+        else:
+            agg = masked.masked_median(clipped, mask)
+        clip_factor = jnp.minimum(1.0, M / jnp.maximum(norms, 1e-12))
+        return agg, lane_diag(mask, clip_factor)
+
+    def aggregate(self, updates: jax.Array) -> jax.Array:
+        return self.aggregate_diag(updates)[0]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -351,14 +470,11 @@ class Clippedclustering(Aggregator):
             "count": jnp.zeros((), jnp.int32),
         }
 
-    def __call__(
-        self,
-        updates: jax.Array,
-        state: AggState = (),
-        *,
-        key: Optional[jax.Array] = None,
-    ) -> Tuple[jax.Array, AggState]:
-        del key
+    def _run(
+        self, updates: jax.Array, state: AggState
+    ) -> Tuple[jax.Array, AggState, jax.Array, jax.Array]:
+        """Shared body: ``(aggregate, new_state, mask, clip factors)`` —
+        the single trace both ``__call__`` and ``diagnose`` return from."""
         n = updates.shape[0]
         norms = jnp.linalg.norm(updates, axis=1)
         if state is None or (isinstance(state, tuple) and not state):
@@ -391,7 +507,33 @@ class Clippedclustering(Aggregator):
             agg = masked.masked_mean(clipped, mask)
         else:
             agg = masked.masked_median(clipped, mask)
-        return agg, {"norm_history": hist, "count": count}
+        clip_factor = jnp.minimum(1.0, threshold / jnp.maximum(norms, 1e-12))
+        return agg, {"norm_history": hist, "count": count}, mask, clip_factor
+
+    def __call__(
+        self,
+        updates: jax.Array,
+        state: AggState = (),
+        *,
+        key: Optional[jax.Array] = None,
+    ) -> Tuple[jax.Array, AggState]:
+        del key
+        agg, new_state, _, _ = self._run(updates, state)
+        return agg, new_state
+
+    def diagnose(
+        self,
+        updates: jax.Array,
+        state: AggState = (),
+        *,
+        key: Optional[jax.Array] = None,
+    ) -> Tuple[jax.Array, AggState, LaneDiag]:
+        """Diag: score = the clip factor ``min(1, threshold/||u_i||)``
+        against the norm-history median threshold; benign_mask = the
+        majority cosine-cluster (∩ SignGuard cluster when enabled)."""
+        del key
+        agg, new_state, mask, clip_factor = self._run(updates, state)
+        return agg, new_state, lane_diag(mask, clip_factor)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -411,7 +553,13 @@ class FLTrust(Aggregator):
 
     expects_trusted_row: bool = True
 
-    def aggregate(self, updates: jax.Array) -> jax.Array:
+    def aggregate_diag(self, updates: jax.Array) -> Tuple[jax.Array, LaneDiag]:
+        """Diag covers the CLIENT rows only (the appended trusted row is
+        the yardstick, not a lane under judgment), so the bundle is one
+        row shorter than ``updates`` and aligns with the round's
+        malicious mask.  Score = cos(u_i, u_server) (higher = more
+        trusted — inverse polarity vs the outlier scores);
+        benign_mask = positive trust (ReLU keeps a nonzero weight)."""
         # Last row is the trusted server update, preceding rows the clients.
         server = updates[-1]
         clients = updates[:-1]
@@ -420,7 +568,11 @@ class FLTrust(Aggregator):
         cos = (clients @ server) / (c_norm * jnp.maximum(s_norm, 1e-12))
         trust = jax.nn.relu(cos)
         rescaled = clients * (s_norm / c_norm)[:, None]
-        return (trust[:, None] * rescaled).sum(axis=0) / jnp.maximum(trust.sum(), 1e-12)
+        agg = (trust[:, None] * rescaled).sum(axis=0) / jnp.maximum(trust.sum(), 1e-12)
+        return agg, lane_diag(trust > 0.0, cos)
+
+    def aggregate(self, updates: jax.Array) -> jax.Array:
+        return self.aggregate_diag(updates)[0]
 
 
 AGGREGATORS = {

@@ -1,0 +1,24 @@
+"""jax version compatibility for the parallel layer.
+
+``shard_map`` moved from ``jax.experimental.shard_map`` (kwarg
+``check_rep``) to top-level ``jax.shard_map`` (kwarg ``check_vma``).
+This shim presents the NEW surface on either jax: callers pass
+``check_vma=`` and it is translated for an old jax underneath.
+"""
+
+from __future__ import annotations
+
+try:  # jax >= 0.5
+    from jax import shard_map as _shard_map
+
+    _REP_KWARG = "check_vma"
+except ImportError:  # jax 0.4.x
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _REP_KWARG = "check_rep"
+
+
+def shard_map(f, *, check_vma=None, **kwargs):
+    if check_vma is not None:
+        kwargs[_REP_KWARG] = check_vma
+    return _shard_map(f, **kwargs)

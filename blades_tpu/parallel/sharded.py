@@ -28,8 +28,9 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+from blades_tpu.parallel.compat import shard_map
 
 from blades_tpu.core.round import FedRound, RoundState
 from blades_tpu.core.server import ServerState

@@ -18,9 +18,27 @@ def main(argv=None) -> int:
     )
     sub = parser.add_subparsers(dest="cmd", required=True)
 
-    p_file = sub.add_parser("file", help="run experiments from a YAML grid file")
+    # Flags shared by BOTH subcommands, defined once (parents=): the run
+    # subcommand silently ignoring --trace was exactly the drift that
+    # copy-pasted flag blocks invite.
+    common = argparse.ArgumentParser(add_help=False)
+    common.add_argument("--storage-path", default="~/blades_tpu_results")
+    common.add_argument("--trace", default=None, metavar="DIR",
+                        help="capture a jax profiler trace into DIR "
+                        "(the reference's --trace flag is dead code; this "
+                        "one works, on both subcommands)")
+    common.add_argument("--metrics-csv", action="store_true",
+                        help="also write <trial>/metrics.csv next to the "
+                        "canonical metrics.jsonl stream")
+    common.add_argument("--no-cost-analysis", action="store_true",
+                        help="skip the per-trial XLA cost analysis (it "
+                        "recompiles the training dispatch once — expensive "
+                        "for ResNet-scale models on CPU)")
+    common.add_argument("-v", "--verbose", action="count", default=1)
+
+    p_file = sub.add_parser("file", parents=[common],
+                            help="run experiments from a YAML grid file")
     p_file.add_argument("experiment_file")
-    p_file.add_argument("--storage-path", default="~/blades_tpu_results")
     p_file.add_argument("--checkpoint-freq", type=int, default=0)
     p_file.add_argument("--checkpoint-at-end", action="store_true")
     p_file.add_argument("--checkpoint-keep-num", type=int, default=None,
@@ -51,20 +69,14 @@ def main(argv=None) -> int:
                         help="disable vmapped lane execution of shape-"
                         "compatible trial groups (seed/lr/eps/scale grids); "
                         "every trial then runs sequentially")
-    p_file.add_argument("--trace", default=None, metavar="DIR",
-                        help="capture a jax profiler trace into DIR "
-                        "(the reference's --trace flag is dead code; this "
-                        "one works)")
-    p_file.add_argument("-v", "--verbose", action="count", default=1)
 
-    p_run = sub.add_parser("run", help="run one algorithm with overrides")
+    p_run = sub.add_parser("run", parents=[common],
+                           help="run one algorithm with overrides")
     p_run.add_argument("algo", help="FEDAVG or FEDAVG_DP")
     p_run.add_argument("--config-json", default="{}",
                        help='flat/nested config overrides as JSON, e.g. '
                        '\'{"dataset_config": {"type": "mnist"}}\'')
     p_run.add_argument("--rounds", type=int, default=100)
-    p_run.add_argument("--storage-path", default="~/blades_tpu_results")
-    p_run.add_argument("-v", "--verbose", action="count", default=1)
 
     args = parser.parse_args(argv)
 
@@ -91,15 +103,10 @@ def main(argv=None) -> int:
                 max_rounds_override=args.max_rounds,
                 max_failures=args.max_failures,
                 lanes=not args.no_lanes,
+                metrics_csv=args.metrics_csv,
+                cost_analysis=not args.no_cost_analysis,
             )
 
-        if args.trace:
-            from blades_tpu.utils.profiling import trace
-
-            with trace(args.trace):
-                summaries = _run()
-        else:
-            summaries = _run()
     else:
         experiments = {
             f"{args.algo.lower()}_run": {
@@ -108,9 +115,25 @@ def main(argv=None) -> int:
                 "config": json.loads(args.config_json),
             }
         }
-        summaries = run_experiments(
-            experiments, storage_path=args.storage_path, verbose=args.verbose
-        )
+
+        def _run():
+            return run_experiments(
+                experiments,
+                storage_path=args.storage_path,
+                verbose=args.verbose,
+                metrics_csv=args.metrics_csv,
+                cost_analysis=not args.no_cost_analysis,
+            )
+
+    # --trace wraps EITHER subcommand (the run subcommand used to silently
+    # ignore it — a one-off run is exactly when you want a profile).
+    if args.trace:
+        from blades_tpu.utils.profiling import trace
+
+        with trace(args.trace):
+            summaries = _run()
+    else:
+        summaries = _run()
     best = max(summaries, key=lambda s: s["best_test_acc"], default=None)
     if best:
         print(f"best trial: {best['trial']} test_acc={best['best_test_acc']:.4f}")

@@ -4,6 +4,7 @@
 from __future__ import annotations
 
 import copy
+import csv
 import itertools
 import json
 import time
@@ -164,6 +165,11 @@ def _lanes_eligible(spec_run: str, trial: Dict, group: List[int]) -> bool:
         and int(getattr(cfg, "rounds_per_dispatch", 1)) == 1
     ):
         return False
+    if getattr(cfg, "forensics", False):
+        # The laned program has no forensics formulation yet — a laned
+        # trial would silently drop the per-lane telemetry the user asked
+        # for, so it runs sequentially.
+        return False
     if cfg.lr_schedule:
         _, ov = _lane_signature(trial)
         if "server_lr" in ov:
@@ -224,13 +230,60 @@ def _truncate_results(path: Path, upto_round: int) -> None:
     """Drop result rows past ``upto_round`` before appending a restored
     run's rows — otherwise a restore from a checkpoint older than the last
     written row would duplicate (and regress) ``training_iteration`` in
-    the line stream that visualization/resume consume."""
-    rows = _read_results(path)
-    kept = [r for r in rows if r.get("training_iteration", 0) <= upto_round]
-    if len(kept) != len(rows):
+    the line stream that visualization/resume consume.  Parses EVERY line
+    itself (not via :func:`_read_results`, which stops at the first bad
+    line): a torn fragment mid-stream — a killed run's tear that a later
+    append sealed — must not make truncation silently discard the valid
+    records after it.  The undecodable fragments themselves are dropped."""
+    if not path.exists():
+        return
+    lines = path.read_text().splitlines()
+    kept = []
+    dirty = False
+    for line in lines:
+        try:
+            r = json.loads(line)
+        except json.JSONDecodeError:
+            dirty = True  # fragment: drop it, keep parsing
+            continue
+        if r.get("training_iteration", 0) <= upto_round:
+            kept.append(line)
+        else:
+            dirty = True
+    if dirty:
         with open(path, "w") as f:
-            for r in kept:
-                f.write(json.dumps(r) + "\n")
+            for line in kept:
+                f.write(line + "\n")
+
+
+def _truncate_csv(path: Path, upto_round: int) -> None:
+    """CSV analogue of :func:`_truncate_results` for ``metrics.csv``: drop
+    rows past ``upto_round`` by the ``training_iteration`` column so a
+    checkpoint-restore retry appends without duplicating rounds.  Parsed
+    with the ``csv`` module (quoted cells may contain commas); a row whose
+    iteration cell does not parse — e.g. a torn final line from a killed
+    run — is KEPT: truncation must never destroy data it cannot read."""
+    if not path.exists():
+        return
+    with open(path, newline="") as f:
+        rows = list(csv.reader(f))
+    if not rows:
+        return
+    try:
+        col = rows[0].index("training_iteration")
+    except ValueError:
+        return
+    kept = [rows[0]]
+    for row in rows[1:]:
+        try:
+            if int(float(row[col])) > upto_round:
+                continue
+        except (IndexError, ValueError):
+            pass
+        kept.append(row)
+    if len(kept) != len(rows):
+        with open(path, "w", newline="") as f:
+            csv.writer(f).writerows(kept)
 
 
 def _latest_checkpoint(tdir: Path) -> Optional[Path]:
@@ -268,11 +321,17 @@ def _run_lane_group(
     exp_name: str,
     root: Path,
     verbose: int,
+    metrics_csv: bool = False,
+    strict_metrics: bool = True,
 ) -> Dict[int, Dict]:
     """Run one lane group as a vmapped program; write each member trial's
-    ``result.json``/``params.json`` exactly as the sequential path does and
-    return its summaries keyed by trial index."""
+    ``result.json``/``params.json``/metrics streams exactly as the
+    sequential path does and return its summaries keyed by trial index.
+    (No stdout heartbeat here: the vmapped program returns all rows only
+    after the whole group finishes, so a replayed 'heartbeat' would be a
+    post-hoc burst, not a liveness signal.)"""
     from blades_tpu.algorithms import get_algorithm_class
+    from blades_tpu.obs import CsvSink, JsonlSink, MetricsLogger
     from blades_tpu.tune.lanes import run_lanes
 
     sig_cfg = None
@@ -313,9 +372,17 @@ def _run_lane_group(
         with open(tdir / "params.json", "w") as f:
             json.dump(_jsonable(trials[i]), f, indent=2, default=str)
         rows = results[lane]
-        with open(tdir / "result.json", "w") as f:
+        sinks: List = [JsonlSink(tdir / "metrics.jsonl",
+                                 strict=strict_metrics)]
+        if metrics_csv:
+            sinks.append(CsvSink(tdir / "metrics.csv"))
+        with open(tdir / "result.json", "w") as f, MetricsLogger(
+            sinks, base={"experiment": exp_name, "trial": tname},
+        ) as logger:
             for r in rows:
-                f.write(json.dumps(_jsonable({**r, "trial": tname})) + "\n")
+                r = _jsonable(r)
+                f.write(json.dumps({**r, "trial": tname}) + "\n")
+                logger.log(r)
         best = max((r.get("test_acc", 0.0) for r in rows), default=0.0)
         final = {k: rows[-1][k] for k in ("test_loss", "test_acc",
                                           "test_acc_top3")
@@ -343,8 +410,36 @@ def run_experiments(
     checkpoint_score_attr: str = "training_iteration",
     max_failures: int = 0,
     lanes: bool = True,
+    metrics_csv: bool = False,
+    heartbeat_every: int = 10,
+    cost_analysis: bool = True,
+    strict_metrics: bool = True,
 ) -> List[Dict]:
     """Run every trial of every experiment; returns summaries.
+
+    **Metrics pipeline** (obs subsystem): every trial also streams one
+    schema-validated JSONL record per round to ``<trial>/metrics.jsonl``
+    (plus ``metrics.csv`` when ``metrics_csv=True`` and a stdout heartbeat
+    every ``heartbeat_every`` rounds at ``verbose > 1``), carrying the
+    training/eval metrics, defense-forensics scalars (``forensics=True``
+    trials), health counts, and per-phase timings.  Each summary gains
+    ``timers`` (sweep-level compile / round / eval / checkpoint phases,
+    ``utils/timers.py``; evaluation runs inside ``algo.train()``, so the
+    ``eval`` phase OVERLAPS compile/round rather than adding to them —
+    subtract it for pure-training estimates) and
+    ``cost`` (XLA's compiled FLOPs/bytes for one
+    training dispatch — NOTE: ``lower().compile()`` cannot reuse the jit
+    cache, so this re-traces and recompiles the dispatch once per trial;
+    pass ``cost_analysis=False`` to skip it when compiles are expensive,
+    e.g. ResNet-scale models on CPU).  Laned trials (vmapped groups) get
+    the same per-round streams but their summaries carry ``lanes`` instead
+    of ``timers``/``cost`` — the vmapped program has no per-trial phase
+    split.  A schema violation fails the trial FAST (no checkpoint-restart
+    retries — it is deterministic); a custom trainable registered into
+    ``ALGORITHMS`` that emits unregistered metric keys should either
+    register them in ``blades_tpu/obs/schema.py`` or pass
+    ``strict_metrics=False``.  A retried trial's streams are truncated to
+    its restore round exactly like ``result.json``.
 
     ``lanes=True`` (default): shape-compatible trial subsets — same static
     config, differing only in lane-traceable knobs (seed, client/server
@@ -376,6 +471,8 @@ def run_experiments(
     summary and the REMAINING trials still run.
     """
     from blades_tpu.algorithms import get_algorithm_class
+    from blades_tpu.obs import CsvSink, JsonlSink, MetricsLogger, StdoutSink
+    from blades_tpu.utils.timers import Timers
 
     root = Path(storage_path).expanduser()
     summaries = []
@@ -396,7 +493,8 @@ def run_experiments(
                 try:
                     laned.update(_run_lane_group(
                         spec["run"], trials, group, max_rounds, exp_name,
-                        root, verbose,
+                        root, verbose, metrics_csv=metrics_csv,
+                        strict_metrics=strict_metrics,
                     ))
                 except Exception as exc:
                     # LOUD fallback: a lane-group failure means the
@@ -431,6 +529,8 @@ def run_experiments(
 
                 for p in tdir.glob("ckpt_*"):
                     shutil.rmtree(p, ignore_errors=True)
+                for p in (tdir / "metrics.jsonl", tdir / "metrics.csv"):
+                    p.unlink(missing_ok=True)
             prior = _read_results(tdir / "result.json") if resume else []
             best_acc = max((r.get("test_acc", 0.0) for r in prior), default=0.0)
             done = prior[-1].get("training_iteration", 0) if prior else 0
@@ -455,6 +555,8 @@ def run_experiments(
                     algo.load_checkpoint(str(ckpt))
                     resumed_from = algo.iteration
                     _truncate_results(tdir / "result.json", algo.iteration)
+                    _truncate_results(tdir / "metrics.jsonl", algo.iteration)
+                    _truncate_csv(tdir / "metrics.csv", algo.iteration)
             with open(tdir / "params.json", "w") as f:
                 json.dump(_jsonable(trial_cfg), f, indent=2, default=str)
             if verbose:
@@ -467,38 +569,70 @@ def run_experiments(
             ckpt_scores: Dict[str, float] = {}
             failures = 0
             failed_error = None
+            timers = Timers()
+            compiled = False
             while True:
                 mode = "a" if (resumed_from or failures) else "w"
+                logger = None
                 try:
+                    # Sinks reopen per attempt (inside the fault-tolerance
+                    # try: an OSError opening a stream is a trial failure,
+                    # not a sweep abort): a retry truncates metrics.jsonl
+                    # under any handle left open from the failed attempt,
+                    # so the stream must be re-entered at the truncated
+                    # offset.
+                    sinks: List = [JsonlSink(tdir / "metrics.jsonl",
+                                             mode=mode,
+                                             strict=strict_metrics)]
+                    if metrics_csv:
+                        sinks.append(CsvSink(tdir / "metrics.csv", mode=mode))
+                    if verbose > 1:
+                        sinks.append(StdoutSink(every=heartbeat_every))
+                    logger = MetricsLogger(
+                        sinks, base={"experiment": exp_name, "trial": tname}
+                    )
                     with open(tdir / "result.json", mode) as f:
                         # Stop on training_iteration (actual FL rounds), not
                         # train() calls — one call advances
                         # rounds_per_dispatch rounds.
                         while algo.iteration < max_rounds:
-                            result = algo.train()
+                            # The first dispatch pays XLA compilation; split
+                            # it from steady-state rounds so neither timing
+                            # pollutes the other.
+                            with timers.time("round" if compiled else "compile"):
+                                result = algo.train()
+                            compiled = True
                             result["trial"] = tname
-                            f.write(json.dumps(_jsonable(result)) + "\n")
+                            row = _jsonable(result)
+                            f.write(json.dumps(row) + "\n")
+                            logger.log(row)
                             best_acc = max(best_acc, result.get("test_acc", 0.0))
                             if checkpoint_freq and algo.iteration % checkpoint_freq == 0:
                                 name = f"ckpt_{algo.iteration:06d}"
-                                algo.save_checkpoint(str(tdir / name))
+                                with timers.time("checkpoint"):
+                                    algo.save_checkpoint(str(tdir / name))
                                 ckpt_scores[name] = float(
                                     result.get(checkpoint_score_attr, algo.iteration)
                                 )
                                 _prune_checkpoints(tdir, checkpoint_keep_num, ckpt_scores)
-                            if verbose > 1 and algo.iteration % 10 == 0:
-                                print(f"  round {algo.iteration}: {result}", flush=True)
                     break
                 except KeyboardInterrupt:
                     raise
                 except Exception as exc:  # Tune's trial fault tolerance
+                    from blades_tpu.obs.schema import SchemaError
+
                     failures += 1
                     import traceback
 
                     with open(tdir / "error.txt", "a") as ef:
                         ef.write(f"attempt {failures}: {exc!r}\n")
                         ef.write(traceback.format_exc() + "\n")
-                    if failures > max_failures:
+                    # SchemaError is deterministic metrics-schema drift, not
+                    # a transient fault: every retry would re-pay the compile
+                    # and fail identically on its first record.  Fail fast
+                    # (without inflating the reported attempt count).
+                    fail_fast = isinstance(exc, SchemaError)
+                    if fail_fast or failures > max_failures:
                         failed_error = repr(exc)
                         if verbose:
                             print(f"   !! trial {tname} FAILED after "
@@ -509,24 +643,48 @@ def run_experiments(
                     _, config = get_algorithm_class(spec["run"], return_config=True)
                     config.update_from_dict(trial_cfg)
                     algo = config.build()
+                    compiled = False  # fresh build recompiles
                     ckpt = _latest_checkpoint(tdir)
                     if ckpt is not None:
                         algo.load_checkpoint(str(ckpt))
                     _truncate_results(tdir / "result.json", algo.iteration)
+                    _truncate_results(tdir / "metrics.jsonl", algo.iteration)
+                    _truncate_csv(tdir / "metrics.csv", algo.iteration)
                     if verbose:
                         print(f"   .. retrying {tname} from round "
                               f"{algo.iteration} (failure {failures}/"
                               f"{max_failures})", flush=True)
+                finally:
+                    if logger is not None:
+                        logger.close()
             if checkpoint_at_end and failed_error is None:
-                algo.save_checkpoint(str(tdir / "ckpt_final"))
+                with timers.time("checkpoint"):
+                    algo.save_checkpoint(str(tdir / "ckpt_final"))
             wall = time.perf_counter() - t0
             new_rounds = algo.iteration - start_round
+            # Sweep-level phase timings (satellite: compile / round / eval /
+            # checkpoint): eval runs INSIDE algo.train(), so its phase
+            # comes from the algorithm's own timers (getattr: custom
+            # trainables registered into ALGORITHMS may not carry Timers)
+            # and its time is also contained in the compile/round phases —
+            # subtract 'eval' from 'round' for pure-training estimates.
+            phase_timers = timers.summary()
+            algo_timers = (algo.timers.summary()
+                           if hasattr(algo, "timers") else {})
+            if "evaluate" in algo_timers:
+                phase_timers["eval"] = algo_timers["evaluate"]
             summary = {
                 "trial": tname, "rounds": algo.iteration, "wall_s": round(wall, 2),
                 "rounds_per_sec": round(new_rounds / wall, 2) if wall else None,
                 "best_test_acc": best_acc, "final": algo._last_eval,
                 "dir": str(tdir),
+                "timers": phase_timers,
             }
+            if (cost_analysis and failed_error is None
+                    and hasattr(algo, "cost_analysis")):
+                cost = algo.cost_analysis()
+                if cost:
+                    summary["cost"] = cost
             if failed_error is not None:
                 summary["status"] = "ERROR"
                 summary["error"] = failed_error

@@ -23,3 +23,11 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: tier-2 tests (multi-device shard_map compiles, large-model "
+        "CPU compiles) excluded from the tier-1 `-m 'not slow'` budget",
+    )

@@ -5,6 +5,11 @@ The d-sharded path must cover the FULL aggregator suite (all 10) and the
 full adversary suite: every combination here compares end-round server
 params against :func:`shard_map_step` (same keys -> same local training,
 so any difference is aggregation/forging math).
+
+Tier-2 (``slow``): the 33 aggregator x adversary combinations each
+compile an 8-virtual-device shard_map program — minutes of wall clock on
+a 2-core CPU host, far past the tier-1 budget.  Tier-1 keeps a d-sharded
+end-to-end signal via ``test_faults.py``'s d-sharded health-check round.
 """
 
 import dataclasses
@@ -21,6 +26,8 @@ from blades_tpu.parallel import make_mesh, shard_federation, shard_map_step
 from blades_tpu.ops import layout as L
 from blades_tpu.parallel.dsharded import dsharded_step
 from blades_tpu.utils.tree import ravel_fn
+
+pytestmark = pytest.mark.slow
 
 N = 16
 F = 4
@@ -88,8 +95,9 @@ def test_psum_pairwise_matches_dense():
 
     from functools import partial
 
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from blades_tpu.parallel.compat import shard_map
 
     shard = L.ShardInfo(axis="clients", num_shards=8, global_d=64, width=8)
 

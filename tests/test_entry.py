@@ -16,6 +16,7 @@ import subprocess
 import sys
 
 import jax
+import pytest
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -30,6 +31,8 @@ def test_entry_compiles_and_runs():
     assert bool(jax.numpy.isfinite(out).all())
 
 
+@pytest.mark.slow  # full ResNet-18 round on an 8-virtual-device mesh:
+# minutes of XLA CPU compile on a 2-core host
 def test_dryrun_multichip_inprocess():
     sys.path.insert(0, REPO_ROOT)
     import __graft_entry__ as ge
@@ -37,6 +40,7 @@ def test_dryrun_multichip_inprocess():
     ge.dryrun_multichip(8)  # raises on failure
 
 
+@pytest.mark.slow  # same program compiled from scratch in a clean subprocess
 def test_dryrun_multichip_self_provisions_clean_process():
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)

@@ -1,0 +1,516 @@
+"""Observability subsystem tests (blades_tpu/obs/): the device half
+(aggregator diagnostics + detection forensics inside the jitted round) and
+the host half (schema-validated metrics pipeline in the sweep runner)."""
+
+import json
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from blades_tpu.obs import (
+    CsvSink,
+    JsonlSink,
+    MetricsLogger,
+    SchemaError,
+    StdoutSink,
+    validate_jsonl,
+    validate_record,
+)
+from blades_tpu.obs.forensics import detection_metrics
+from blades_tpu.ops.aggregators import (
+    Centeredclipping,
+    Clippedclustering,
+    DnC,
+    FLTrust,
+    GeoMed,
+    Mean,
+    Median,
+    Multikrum,
+    Signguard,
+    Trimmedmean,
+)
+
+KEY = jax.random.PRNGKey(0)
+
+
+# ---------------------------------------------------------------------------
+# forensics: detection confusion-matrix scalars
+# ---------------------------------------------------------------------------
+
+
+def test_detection_metrics_known_confusion():
+    # lanes:      0  1  2  3  4  5
+    benign = jnp.array([1, 1, 0, 0, 1, 0], bool)   # flagged: 2, 3, 5
+    malicious = jnp.array([0, 0, 1, 0, 0, 1], bool)  # truth: 2, 5
+    m = detection_metrics(benign, malicious)
+    assert np.isclose(float(m["byz_precision"]), 2 / 3)  # tp=2 of 3 flags
+    assert np.isclose(float(m["byz_recall"]), 1.0)       # both caught
+    assert np.isclose(float(m["byz_fpr"]), 1 / 4)        # lane 3 of 4 benign
+    assert int(m["num_flagged"]) == 3
+
+
+def test_detection_metrics_degenerate_edges():
+    # Nothing flagged, nothing malicious: perfect by convention.
+    benign = jnp.ones(5, bool)
+    none = jnp.zeros(5, bool)
+    m = detection_metrics(benign, none)
+    assert float(m["byz_precision"]) == 1.0
+    assert float(m["byz_recall"]) == 1.0
+    assert float(m["byz_fpr"]) == 0.0
+    assert int(m["num_flagged"]) == 0
+    # Keep-all defense vs a real attack: recall honestly 0.
+    m = detection_metrics(benign, jnp.array([1, 1, 0, 0, 0], bool))
+    assert float(m["byz_recall"]) == 0.0
+    assert float(m["byz_precision"]) == 1.0  # no false alarms either
+
+
+def test_detection_metrics_runs_under_jit():
+    f = jax.jit(detection_metrics)
+    m = f(jnp.array([1, 0, 1], bool), jnp.array([0, 1, 0], bool))
+    assert float(m["byz_recall"]) == 1.0
+    assert float(m["byz_fpr"]) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# aggregator diagnostics: diagnose() must be bit-identical to __call__
+# ---------------------------------------------------------------------------
+
+_PARITY_AGGS = [
+    Mean(),
+    Median(),
+    Trimmedmean(num_byzantine=1),
+    GeoMed(),
+    DnC(num_byzantine=1, sub_dim=8, num_iters=2),
+    Multikrum(num_byzantine=1, k=2),
+    Centeredclipping(),
+    Signguard(),
+    Clippedclustering(history_rounds=4),
+]
+
+
+@pytest.mark.parametrize("agg", _PARITY_AGGS, ids=lambda a: a.name)
+def test_diagnose_aggregate_bit_identical(agg):
+    """Acceptance: with diagnostics enabled the aggregate (and threaded
+    state) must be BIT-identical to the plain __call__ path."""
+    x = jax.random.normal(jax.random.PRNGKey(3), (8, 32))
+    state = agg.init(32, 8)
+    key = jax.random.PRNGKey(7)
+
+    plain, plain_state = jax.jit(lambda u, s, k: agg(u, s, key=k))(x, state, key)
+    diag_agg, diag_state, diag = jax.jit(
+        lambda u, s, k: agg.diagnose(u, s, key=k)
+    )(x, state, key)
+
+    np.testing.assert_array_equal(np.asarray(plain), np.asarray(diag_agg))
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        plain_state, diag_state,
+    )
+    assert diag["benign_mask"].shape == (8,) and diag["benign_mask"].dtype == bool
+    assert diag["scores"].shape == (8,) and diag["scores"].dtype == jnp.float32
+    assert np.all(np.isfinite(np.asarray(diag["scores"])))
+
+
+def test_fltrust_diagnose_parity_and_client_axis():
+    """FLTrust's diag covers CLIENT rows only (the appended trusted row is
+    the yardstick), one row shorter than its input matrix."""
+    agg = FLTrust()
+    x = jax.random.normal(jax.random.PRNGKey(5), (9, 16))  # 8 clients + root
+    plain, _ = agg(x)
+    diag_agg, _, diag = agg.diagnose(x)
+    np.testing.assert_array_equal(np.asarray(plain), np.asarray(diag_agg))
+    assert diag["benign_mask"].shape == (8,)
+    assert diag["scores"].shape == (8,)
+
+
+def test_multikrum_mask_selects_k_and_flags_outlier():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(8, 16)) * 0.1)
+    x = x.at[0].set(100.0)  # isolated lane
+    agg = Multikrum(num_byzantine=2, k=3)
+    _, _, diag = agg.diagnose(x)
+    mask = np.asarray(diag["benign_mask"])
+    assert mask.sum() == 3
+    assert not mask[0]  # the outlier is never among the k selected
+    assert np.asarray(diag["scores"])[0] == np.asarray(diag["scores"]).max()
+
+
+def test_trimmedmean_mask_flags_always_trimmed_lane():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(8, 16)) * 0.1)
+    x = x.at[0].set(50.0)  # max on every coordinate -> always trimmed
+    agg = Trimmedmean(num_byzantine=1)
+    _, _, diag = agg.diagnose(x)
+    assert not bool(diag["benign_mask"][0])
+    assert float(diag["scores"][0]) == 1.0  # trimmed on 100% of coords
+
+
+def test_signguard_mask_flags_sign_flipped_large_lane():
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.normal(size=(10, 32)) * 0.1 + 1.0)
+    x = x.at[0].set(-40.0 * jnp.abs(x[0]))  # sign-flipped, huge norm
+    agg = Signguard()
+    _, _, diag = agg.diagnose(x)
+    assert not bool(diag["benign_mask"][0])
+    # Clip factor: benign lanes untouched (1.0), the huge lane clipped hard.
+    scores = np.asarray(diag["scores"])
+    assert scores[0] < 0.2 and np.all(scores[1:] > 0.5)
+
+
+def test_fltrust_mask_flags_negative_cosine():
+    server = jnp.ones((1, 8))
+    clients = jnp.concatenate([jnp.ones((3, 8)), -jnp.ones((1, 8))])
+    _, _, diag = FLTrust().diagnose(jnp.concatenate([clients, server]))
+    mask = np.asarray(diag["benign_mask"])
+    assert list(mask) == [True, True, True, False]
+    assert float(diag["scores"][-1]) < 0  # raw cosine, pre-ReLU
+
+
+# ---------------------------------------------------------------------------
+# schema validation
+# ---------------------------------------------------------------------------
+
+
+def _good_record(**over):
+    rec = {
+        "experiment": "exp",
+        "trial": "exp_00000",
+        "training_iteration": 3,
+        "train_loss": 1.25,
+        "agg_norm": 0.5,
+        "update_norm_mean": 0.7,
+        "timers": {"training_step": {"mean_s": 0.1, "total_s": 0.3, "count": 3}},
+    }
+    rec.update(over)
+    return rec
+
+
+def test_validate_record_accepts_full_record():
+    rec = _good_record(
+        test_loss=2.0, test_acc=0.4, test_acc_top3=0.8,
+        num_unhealthy=0, round_ok=True,
+        byz_precision=1.0, byz_recall=0.5, byz_fpr=0.0, num_flagged=2,
+        lane_forensics={
+            "benign_mask": [True, False], "healthy": [True, True],
+            "scores": [0.1, 9.0],
+        },
+        seed=7, client_lr=0.1,
+    )
+    assert validate_record(rec) is rec
+
+
+def test_validate_record_rejects_unknown_key():
+    with pytest.raises(SchemaError, match="unknown keys.*brand_new_metric"):
+        validate_record(_good_record(brand_new_metric=1.0))
+
+
+def test_validate_record_rejects_missing_required_and_bad_type():
+    rec = _good_record()
+    del rec["training_iteration"]
+    with pytest.raises(SchemaError,
+                       match="missing required key 'training_iteration'"):
+        validate_record(rec)
+    with pytest.raises(SchemaError, match="'training_iteration' must be"):
+        validate_record(_good_record(training_iteration="3"))
+    # bool is not a number (int-subclass leak).
+    with pytest.raises(SchemaError, match="'train_loss' must be"):
+        validate_record(_good_record(train_loss=True))
+
+
+def test_validate_record_rejects_lane_length_mismatch():
+    with pytest.raises(SchemaError, match="disagree on lane count"):
+        validate_record(_good_record(lane_forensics={
+            "benign_mask": [True, False], "scores": [0.1],
+        }))
+
+
+def test_validate_jsonl_reports_line_numbers(tmp_path):
+    p = tmp_path / "m.jsonl"
+    with open(p, "w") as f:
+        f.write(json.dumps(_good_record()) + "\n")
+        f.write("\n")  # blank lines tolerated
+        f.write(json.dumps(_good_record(bogus=1)) + "\n")
+        f.write('{"torn": ')  # killed-run torn final line
+    num_valid, errors = validate_jsonl(p)
+    assert num_valid == 1
+    assert [ln for ln, _ in errors] == [3, 4]
+
+
+def test_schema_cli_validator(tmp_path, capsys):
+    from blades_tpu.obs.schema import main as schema_main
+
+    good = tmp_path / "good.jsonl"
+    good.write_text(json.dumps(_good_record()) + "\n")
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text(json.dumps(_good_record(oops=1)) + "\n")
+    assert schema_main([str(good)]) == 0
+    assert schema_main([str(bad)]) == 1
+    assert "unknown keys" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# sinks + logger
+# ---------------------------------------------------------------------------
+
+
+def test_jsonl_sink_round_trips_and_enforces_schema(tmp_path):
+    p = tmp_path / "m.jsonl"
+    sink = JsonlSink(p)
+    sink.emit(_good_record())
+    with pytest.raises(SchemaError):
+        sink.emit(_good_record(not_registered=1))
+    sink.close()
+    num_valid, errors = validate_jsonl(p)
+    assert (num_valid, errors) == (1, [])
+
+
+def test_csv_sink_schema_columns_capture_late_eval_keys(tmp_path):
+    """Columns come from the SCHEMA, not the first record — eval metrics
+    that first appear mid-run must land in their column, not be dropped."""
+    p = tmp_path / "m.csv"
+    sink = CsvSink(p)
+    sink.emit({"trial": "a,b", "training_iteration": 1, "train_loss": 0.5,
+               "timers": {"skipped": {}}})
+    sink.emit({"trial": "t", "training_iteration": 2, "train_loss": 0.25,
+               "test_acc": 0.75,  # absent from record 1: still has a column
+               "late_key": 9})    # unregistered: dropped
+    sink.close()
+    lines = p.read_text().splitlines()
+    header = lines[0].split(",")
+    assert {"trial", "training_iteration", "train_loss", "test_acc",
+            "byz_recall"} <= set(header)
+    assert "timers" not in header and "lane_forensics" not in header
+    assert "late_key" not in header
+    row2 = dict(zip(header, lines[2].split(",")))
+    assert row2["test_acc"] == "0.75"
+    assert '"a,b"' in lines[1]  # comma cell quoted
+
+
+def test_truncate_csv_keeps_rows_it_cannot_parse(tmp_path):
+    """A quoted comma cell or torn final line must never make truncation
+    destroy the rest of the stream."""
+    from blades_tpu.tune.sweep import _truncate_csv
+
+    p = tmp_path / "m.csv"
+    p.write_text('experiment,trial,training_iteration\n'
+                 '"a,b",t,1\n'
+                 '"a,b",t,2\n'
+                 '"a,b",t,3\n'
+                 '"a,b",t\n')  # torn final line: kept
+    _truncate_csv(p, upto_round=2)
+    lines = p.read_text().splitlines()
+    assert len(lines) == 4  # header + rounds 1,2 + torn line; round 3 gone
+    assert lines[1].startswith('"a,b"')
+    assert lines[-1] == '"a,b",t'
+
+
+def test_stdout_sink_heartbeat_cadence(capsys):
+    sink = StdoutSink(every=2)
+    for i in range(1, 4):
+        sink.emit({"experiment": "e", "trial": "t", "training_iteration": i,
+                   "train_loss": 0.5})
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 2  # records 1 (always) and 2 (every=2); 3 skipped
+    assert "round 1" in out[0] and "loss=0.5000" in out[0]
+
+
+def test_metrics_logger_stamps_base_and_fans_out(tmp_path):
+    p = tmp_path / "m.jsonl"
+    with MetricsLogger(
+        [JsonlSink(p)], base={"experiment": "e", "trial": "t"}
+    ) as logger:
+        rec = logger.log({"training_iteration": 1, "train_loss": 0.5})
+    assert rec["experiment"] == "e"
+    row = json.loads(p.read_text())
+    assert row["trial"] == "t" and row["train_loss"] == 0.5
+
+
+# ---------------------------------------------------------------------------
+# the jitted round end-to-end (Fedavg + forensics)
+# ---------------------------------------------------------------------------
+
+N_CLIENTS, N_BYZ = 10, 3
+
+
+def _forensics_config(aggregator, forensics=True, seed=3):
+    from blades_tpu.algorithms import get_algorithm_class
+
+    _, cfg = get_algorithm_class("FEDAVG", return_config=True)
+    cfg.update_from_dict({
+        "dataset_config": {"type": "mnist", "num_clients": N_CLIENTS,
+                           "train_bs": 8, "seed": seed},
+        "global_model": "mlp",
+        "evaluation_interval": 10,
+        "num_malicious_clients": N_BYZ,
+        "adversary_config": {"type": "ALIE"},
+        "server_config": {"lr": 1.0, "aggregator": aggregator},
+        "forensics": forensics,
+    })
+    return cfg
+
+
+def test_forensics_metrics_consistent_with_emitted_mask():
+    """The scalar precision/recall the round emits must agree with a host
+    recomputation from the per-lane mask it emits alongside (malicious =
+    the first num_malicious lanes, adversaries/base.py)."""
+    algo = _forensics_config({"type": "Multikrum", "k": 5}).build()
+    r = algo.train()
+    lanes = r["lane_forensics"]
+    assert len(lanes["benign_mask"]) == N_CLIENTS
+    assert len(lanes["healthy"]) == N_CLIENTS
+    assert len(lanes["scores"]) == N_CLIENTS
+    flagged = np.asarray([not b for b in lanes["benign_mask"]])
+    truth = np.arange(N_CLIENTS) < N_BYZ
+    tp = (flagged & truth).sum()
+    exp_prec = tp / flagged.sum() if flagged.sum() else 1.0
+    exp_rec = tp / truth.sum()
+    assert np.isclose(r["byz_precision"], exp_prec)
+    assert np.isclose(r["byz_recall"], exp_rec)
+    assert r["num_flagged"] == int(flagged.sum())
+    assert r["num_unhealthy"] == 0 and all(lanes["healthy"])
+    assert 0.0 <= r["byz_fpr"] <= 1.0
+
+
+def test_forensics_off_training_is_bit_identical():
+    """Acceptance: diagnostics disabled -> the training trajectory (params
+    and losses) is bit-identical to the forensics run, round for round."""
+    algo_off = _forensics_config("Median", forensics=False).build()
+    algo_on = _forensics_config("Median", forensics=True).build()
+    for _ in range(3):
+        r_off, r_on = algo_off.train(), algo_on.train()
+        assert r_off["train_loss"] == r_on["train_loss"]
+        assert "byz_recall" in r_on and "byz_recall" not in r_off
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        algo_off.state.server.params, algo_on.state.server.params,
+    )
+
+
+def test_forensics_rejects_sharded_paths():
+    cfg = _forensics_config("Median")
+    cfg.resources(num_devices=8)
+    with pytest.raises(ValueError, match="single-chip"):
+        cfg.validate()
+    cfg2 = _forensics_config("Median")
+    cfg2.update_from_dict({"execution": "streamed"})
+    with pytest.raises(ValueError, match="dense"):
+        cfg2.validate()
+
+
+# ---------------------------------------------------------------------------
+# the metrics pipeline end-to-end (acceptance criterion)
+# ---------------------------------------------------------------------------
+
+
+def test_sweep_alie_emits_schema_valid_forensics_jsonl(tmp_path):
+    """20-round synthetic ALIE sweep over Krum/DnC/SignGuard/trimmed-mean:
+    every trial streams 20 schema-valid JSONL records carrying per-round
+    detection precision/recall, plus phase timers and compiled cost in the
+    summary."""
+    from blades_tpu.tune import run_experiments
+
+    experiments = {
+        "forensics_alie": {
+            "run": "FEDAVG",
+            "stop": {"training_iteration": 20},
+            "config": {
+                "dataset_config": {"type": "mnist", "num_clients": N_CLIENTS,
+                                   "train_bs": 8, "seed": 3},
+                "global_model": "mlp",
+                "evaluation_interval": 10,
+                "num_malicious_clients": N_BYZ,
+                "adversary_config": {"type": "ALIE"},
+                "forensics": True,
+                "server_config": {
+                    "lr": 1.0,
+                    "aggregator": {"grid_search": [
+                        {"type": "Multikrum", "k": 5},   # Krum family
+                        {"type": "DnC", "sub_dim": 64, "num_iters": 2},
+                        {"type": "Signguard"},
+                        {"type": "Trimmedmean"},
+                    ]},
+                },
+            },
+        }
+    }
+    summaries = run_experiments(
+        experiments, storage_path=str(tmp_path), verbose=0, metrics_csv=True
+    )
+    assert len(summaries) == 4
+    for s in summaries:
+        assert "status" not in s, s.get("error")
+        stream = Path(s["dir"]) / "metrics.jsonl"
+        num_valid, errors = validate_jsonl(stream)
+        assert errors == [] and num_valid == 20
+        rows = [json.loads(l) for l in stream.read_text().splitlines()]
+        assert [r["training_iteration"] for r in rows] == list(range(1, 21))
+        for r in rows:
+            assert 0.0 <= r["byz_precision"] <= 1.0
+            assert 0.0 <= r["byz_recall"] <= 1.0
+            assert len(r["lane_forensics"]["benign_mask"]) == N_CLIENTS
+        # Phase timers (satellite: compile/round/eval/checkpoint wiring).
+        tm = s["timers"]
+        assert tm["compile"]["count"] == 1
+        assert tm["round"]["count"] == 19
+        assert "eval" in tm
+        # Compiled-cost analysis from XLA.
+        assert s["cost"]["flops"] > 0
+        # CSV sibling carries the scalar columns.
+        csv_lines = (Path(s["dir"]) / "metrics.csv").read_text().splitlines()
+        assert len(csv_lines) == 21
+        assert "byz_recall" in csv_lines[0].split(",")
+
+
+def test_sweep_laned_trials_emit_schema_valid_jsonl(tmp_path):
+    """The vmapped lane path writes the same schema-valid stream, with the
+    lane knobs (seed) stamped per row."""
+    from blades_tpu.tune import run_experiments
+
+    experiments = {
+        "laned": {
+            "run": "FEDAVG",
+            "stop": {"training_iteration": 2},
+            "config": {
+                "dataset_config": {"type": "mnist", "num_clients": 4,
+                                   "train_bs": 8,
+                                   "seed": {"grid_search": [0, 1]}},
+                "global_model": "mlp",
+                "evaluation_interval": 2,
+                "server_config": {"lr": 1.0},
+            },
+        }
+    }
+    summaries = run_experiments(experiments, storage_path=str(tmp_path),
+                                verbose=0)
+    assert [s.get("lanes") for s in summaries] == [2, 2]
+    for s in summaries:
+        num_valid, errors = validate_jsonl(Path(s["dir"]) / "metrics.jsonl")
+        assert errors == [] and num_valid == 2
+        row = json.loads(
+            (Path(s["dir"]) / "metrics.jsonl").read_text().splitlines()[0])
+        assert "seed" in row and row["experiment"] == "laned"
+
+
+def test_cli_run_honours_trace_and_metrics_csv(tmp_path, monkeypatch):
+    """Satellite: the run subcommand used to silently ignore --trace."""
+    import blades_tpu.tune as tune_mod
+    from blades_tpu.train import main
+
+    seen = {}
+
+    def fake_run_experiments(experiments, **kw):
+        seen["experiments"] = experiments
+        seen["kw"] = kw
+        return [{"trial": "t", "best_test_acc": 0.0}]
+
+    monkeypatch.setattr(tune_mod, "run_experiments", fake_run_experiments)
+    trace_dir = tmp_path / "trace"
+    rc = main(["run", "FEDAVG", "--rounds", "2",
+               "--trace", str(trace_dir), "--metrics-csv"])
+    assert rc == 0
+    assert seen["kw"]["metrics_csv"] is True
+    assert seen["experiments"]["fedavg_run"]["stop"]["training_iteration"] == 2
+    assert trace_dir.exists()  # the profiler actually started/stopped

@@ -1,6 +1,9 @@
 """Client-count padding to a mesh multiple (VERDICT r1 #6): uneven
 federations shard by zero-padding ghost lanes that must never leak into
-forging/aggregation/metrics."""
+forging/aggregation/metrics.
+
+Tier-2 (``slow``): every case compiles an 8-virtual-device shard_map
+program — too slow for the tier-1 budget on a 2-core CPU host."""
 
 import dataclasses
 
@@ -19,6 +22,8 @@ from blades_tpu.parallel import (
 )
 from blades_tpu.parallel.mesh import pad_to_multiple
 from blades_tpu.utils.tree import ravel_fn
+
+pytestmark = pytest.mark.slow
 
 N = 10  # deliberately NOT divisible by the 8-device mesh
 

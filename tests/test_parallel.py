@@ -1,6 +1,10 @@
 """Multi-chip sharding tests on the 8-virtual-device CPU mesh
 (the analogue of the reference's multi-node-without-a-cluster testing,
-SURVEY.md §4; conftest.py forces the device count)."""
+SURVEY.md §4; conftest.py forces the device count).
+
+Tier-2 (``slow``): each 8-virtual-device shard_map compile costs ~10s of
+wall clock on a 2-core CPU host; the tier-1 budget keeps the dense-path
+suites instead."""
 
 import jax
 import jax.numpy as jnp
@@ -17,6 +21,8 @@ from blades_tpu.parallel import (
     sharded_step,
 )
 from blades_tpu.parallel.sharded import sharded_evaluate
+
+pytestmark = pytest.mark.slow
 
 N_CLIENTS = 16  # 2 per device
 

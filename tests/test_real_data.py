@@ -104,6 +104,7 @@ def test_real_data_trains_end_to_end(data_root):
     assert "test_acc" in r
 
 
+@pytest.mark.slow  # the shrunk ResNet-34 point is still minutes of CPU compile
 def test_cifar100_yaml_runs_two_rounds(tmp_path):
     """BASELINE config 5's YAML parses (DnC + FLTrust grid); a shrunk
     DnC instance runs 2 rounds with ResNet-34.  The FLTrust point is
