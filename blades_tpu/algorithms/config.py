@@ -90,6 +90,12 @@ class FedavgConfig:
         # failure detection / elastic recovery (core/health.py): zero
         # non-finite client lanes, skip non-finite server updates
         self.health_check: bool = False
+        # chaos layer (blades_tpu/faults): deterministic fault-injection
+        # spec, e.g. {"dropout_rate": 0.3, "num_stragglers": 1,
+        # "staleness": 2, "corrupt_rate": 0.01, "corrupt_mode": "nan",
+        # "seed": 7}.  Seed defaults to the trial seed.  None disables —
+        # the round program is then bit-identical to a faultless build.
+        self.fault_config: Optional[Dict] = None
         # defense forensics (obs subsystem): per-lane aggregator telemetry
         # + Byzantine detection precision/recall/FPR emitted from inside
         # the jitted round; dense single-chip execution only
@@ -172,10 +178,12 @@ class FedavgConfig:
                          update_dtype=update_dtype,
                          compute_dtype=compute_dtype)
 
-    def fault_tolerance(self, *, health_check=None):
-        """In-round failure detection / elastic recovery (core/health.py);
-        the trial-level analogue is ``run_experiments(max_failures=)``."""
-        return self._set(health_check=health_check)
+    def fault_tolerance(self, *, health_check=None, faults=None):
+        """In-round failure detection / elastic recovery (core/health.py)
+        and the chaos layer's fault-injection spec (``faults=`` a dict for
+        :class:`blades_tpu.faults.FaultInjector`); the trial-level
+        analogue is ``run_experiments(max_failures=)``."""
+        return self._set(health_check=health_check, fault_config=faults)
 
     def observability(self, *, forensics=None):
         """Defense forensics: per-lane aggregator diagnostics + Byzantine
@@ -308,6 +316,25 @@ class FedavgConfig:
                     "under shard_map would shard the lane axis — run the "
                     "forensic pass without num_devices, or disable forensics"
                 )
+        if self.fault_config:
+            # Build the injector now so a bad spec fails at validate()
+            # time (FaultInjector.__post_init__ range-checks every knob).
+            self.get_fault_injector()
+            if self.execution in ("streamed", "dsharded"):
+                raise ValueError(
+                    "fault injection (fault_config) is only formulated for "
+                    "the dense round — the streamed/d-sharded paths never "
+                    "materialise the participation mask the masked "
+                    "aggregators consume; use execution='dense' (or 'auto' "
+                    "within the dense budget) or disable faults"
+                )
+            if self.num_devices and self.num_devices > 1:
+                raise ValueError(
+                    "fault injection is single-chip for now: the "
+                    "participation mask under shard_map would shard the "
+                    "lane axis — run the chaos pass without num_devices, "
+                    "or disable faults"
+                )
         if str(self.update_dtype) not in ("bfloat16", "float32"):
             raise ValueError(
                 f"update_dtype must be 'bfloat16' or 'float32', got "
@@ -371,6 +398,22 @@ class FedavgConfig:
             num_classes=self.num_classes,
         )
 
+    def get_fault_injector(self):
+        """Build the chaos layer's :class:`~blades_tpu.faults.FaultInjector`
+        from ``fault_config`` (None when disabled).  The fault-process
+        seed defaults to the trial seed so a seed grid sweeps the failure
+        realizations too; set an explicit ``seed`` in the spec to pin the
+        failure process across a training-seed grid."""
+        if not self.fault_config:
+            return None
+        from blades_tpu.faults import FaultInjector
+
+        spec = dict(self.fault_config)
+        spec.setdefault("seed", int(self.seed))
+        # YAML-style dropout_schedule lists are normalized (sorted tuple of
+        # (int, float) pairs) by FaultInjector.__post_init__ itself.
+        return FaultInjector(**spec)
+
     def get_client_callbacks(self) -> tuple:
         from blades_tpu.core.callbacks import ClippingCallback, get_callback
 
@@ -414,6 +457,7 @@ class FedavgConfig:
             num_clients=self.num_clients,
             health_check=self.health_check,
             forensics=self.forensics,
+            faults=self.get_fault_injector(),
         )
 
     def build(self):

@@ -148,13 +148,13 @@ class Fedavg:
                 self._step = sharded_step(self.fed_round, self.mesh, donate=False)
             self._evaluate = sharded_evaluate(self.fed_round, self.mesh)
         elif self._use_streamed():
-            if cfg.forensics:
+            if cfg.forensics or cfg.fault_config:
+                what = "forensics" if cfg.forensics else "fault injection"
                 raise ValueError(
-                    "forensics needs the dense round but 'auto' execution "
+                    f"{what} needs the dense round but 'auto' execution "
                     "resolved to streaming (the dense (n, d) matrix would "
                     f"strain HBM at num_clients={cfg.num_clients}); shrink "
-                    "the federation for the forensic pass or disable "
-                    "forensics"
+                    f"the federation for this pass or disable {what}"
                 )
             from blades_tpu.parallel.streamed import streamed_step
 
@@ -374,6 +374,13 @@ class Fedavg:
             "update_norm_mean": metrics["update_norm_mean"],
             "timers": self.timers.summary(),
         }
+        if self.config.fault_config:  # chaos layer (blades_tpu/faults)
+            # Participation is per round; report the dispatch's LAST round
+            # (consistent with the scalar metrics above) plus the static
+            # fault seed so a chaos run's metrics stream is replayable.
+            for k in ("num_participating", "num_straggled", "num_dropped"):
+                result[k] = int(metrics[k])
+            result["fault_seed"] = int(self.fed_round.faults.seed)
         if self.config.health_check or self.config.forensics:
             # Reduce over the dispatch chunk, not just its last round: a
             # lane that went non-finite mid-chunk must surface even if it
@@ -501,6 +508,23 @@ class Fedavg:
                 server=state.server,
                 client_opt=jax.tree.map(lambda a: a[remap],
                                         state.client_opt),
+                # Stale-update buffer rows are per-client too (chaos
+                # layer); remap along its client axis (axis 1).
+                stale=(None if getattr(state, "stale", None) is None
+                       else state.stale[:, remap]),
+            )
+        faults = self.fed_round.faults
+        if (faults is not None and faults.needs_stale_buffer
+                and getattr(state, "stale", None) is None):
+            # Checkpoint from a run without a straggler process resumed
+            # under one: start the ring buffer cold (zeros), exactly like
+            # a fresh init.
+            from blades_tpu.utils.tree import ravel_fn
+
+            _, _, d = ravel_fn(state.server.params)
+            state = type(state)(
+                server=state.server, client_opt=state.client_opt,
+                stale=faults.init_stale_buffer(n, d),
             )
         if self.mesh is not None:
             from blades_tpu.parallel import shard_federation

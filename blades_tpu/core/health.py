@@ -21,7 +21,11 @@ Both are detectable and recoverable inside the jitted program:
 
 Process-level failures (a crashed trial) are handled host-side by the
 sweep runner's checkpoint-restart policy (``max_failures`` in
-:func:`blades_tpu.tune.sweep.run_experiments`), mirroring Tune.
+:func:`blades_tpu.tune.sweep.run_experiments`), mirroring Tune — hardened
+by :mod:`blades_tpu.faults.host` (atomic checkpoints, retry backoff).
+The failure processes themselves — dropout, stragglers, lane corruption —
+are injected deterministically by :mod:`blades_tpu.faults.injector`; this
+module is the recovery half of that chaos layer.
 """
 
 from __future__ import annotations
@@ -32,7 +36,9 @@ import jax
 import jax.numpy as jnp
 
 
-def sanitize_updates(updates: jax.Array) -> Tuple[jax.Array, jax.Array]:
+def sanitize_updates(
+    updates: jax.Array, participation: jax.Array = None
+) -> Tuple[jax.Array, jax.Array]:
     """Detect and neutralise unhealthy client lanes.
 
     A lane with ANY non-finite coordinate is zeroed ENTIRELY: its finite
@@ -44,13 +50,20 @@ def sanitize_updates(updates: jax.Array) -> Tuple[jax.Array, jax.Array]:
 
     Args:
         updates: ``(n, d)`` stacked client update matrix.
+        participation: optional ``(n,)`` bool mask from the chaos layer
+            (:mod:`blades_tpu.faults`).  A non-participating lane is
+            REPORTED healthy — it delivered nothing this round, so it
+            cannot be unhealthy, and ``num_unhealthy`` must not count it
+            — but a non-finite row is still zeroed either way (it never
+            enters the aggregate, belt and braces).
 
     Returns:
         ``(clean, healthy)`` — the matrix with unhealthy rows zeroed, and
         the ``(n,)`` bool lane-health mask (True = finite row).
     """
-    healthy = jnp.isfinite(updates).all(axis=-1)
-    return jnp.where(healthy[:, None], updates, 0.0), healthy
+    finite = jnp.isfinite(updates).all(axis=-1)
+    healthy = finite if participation is None else finite | ~participation
+    return jnp.where(finite[:, None], updates, 0.0), healthy
 
 
 def guard_server_state(ok: jax.Array, new: Any, old: Any) -> Any:

@@ -39,15 +39,25 @@ Hooks = namedtuple("Hooks", ["data", "grad", "round_begin", "round_end"])
 
 @dataclasses.dataclass
 class RoundState:
-    """Full training state: replicated server + stacked per-client opt states."""
+    """Full training state: replicated server + stacked per-client opt states.
+
+    ``stale`` is the chaos layer's ``(staleness, n, d)`` stale-update ring
+    buffer (row ``-1`` oldest; see :mod:`blades_tpu.faults.injector`) —
+    ``None`` unless a straggler process is configured, so the pytree of a
+    fault-free run carries no extra leaves and existing checkpoints /
+    sharding specs are unchanged.
+    """
 
     server: ServerState
     client_opt: Any  # pytree stacked over the client axis
+    stale: Any = None
 
 
 jax.tree_util.register_pytree_node(
     RoundState,
-    lambda s: ((s.server, s.client_opt), None),
+    # getattr: checkpoints pickled before the chaos layer existed restore
+    # as RoundState instances without a `stale` attribute.
+    lambda s: ((s.server, s.client_opt, getattr(s, "stale", None)), None),
     lambda _, c: RoundState(*c),
 )
 
@@ -95,6 +105,13 @@ class FedRound:
     # unchanged (Python-level branch on static config); the diagnose()
     # aggregate shares __call__'s trace, so numerics match either way.
     forensics: bool = False
+    # Chaos layer (blades_tpu/faults): a FaultInjector composing dropout /
+    # straggler / lane-corruption processes inside the jitted round, with
+    # participation-aware aggregation.  None (the default) keeps the round
+    # program LITERALLY unchanged — bit-identical numerics (Python-level
+    # branch on static config) — and a full-participation round under an
+    # injector still takes the dense aggregation trace via lax.cond.
+    faults: Any = None
 
     # -- construction -------------------------------------------------------
 
@@ -104,8 +121,20 @@ class FedRound:
         client_opt = jax.tree.map(
             lambda x: jnp.broadcast_to(x, (num_clients,) + jnp.shape(x)), opt0
         )
+        stale = None
+        if self.faults is not None and self.faults.needs_stale_buffer:
+            from blades_tpu.utils.tree import ravel_fn
+
+            _, _, d = ravel_fn(params)
+            # Buffer rows match the POST-ghost-slice matrix (true
+            # federation size), the shape inject() sees.
+            stale = self.faults.init_stale_buffer(
+                self.num_clients or num_clients, d
+            )
         return RoundState(
-            server=self.server.init(params, num_clients), client_opt=client_opt
+            server=self.server.init(params, num_clients),
+            client_opt=client_opt,
+            stale=stale,
         )
 
     # -- hooks --------------------------------------------------------------
@@ -169,17 +198,34 @@ class FedRound:
         k = self.num_clients
         if k is not None and k < updates.shape[0]:
             updates, losses, malicious = updates[:k], losses[:k], malicious[:k]
+        # Chaos layer (blades_tpu/faults): dropout / stragglers / lane
+        # corruption, realized deterministically from (fault seed, round).
+        # Runs at the point the updates "arrive at the server" — before
+        # the health check, so corruption is exactly what sanitize_updates
+        # must catch.  Forging runs AFTER, on the full matrix: the
+        # adversary stays omniscient (it sees every locally-computed
+        # update, dropped lanes' included — the strongest-adversary
+        # convention of the Byzantine literature), while the SERVER only
+        # ever aggregates the participating cohort.
+        participation = straggled = None
+        stale = getattr(state, "stale", None)
+        if self.faults is not None:
+            updates, stale, participation, straggled, _corrupted = (
+                self.faults.inject(updates, stale, state.server.round)
+            )
         healthy = None
         if self.health_check:
             from blades_tpu.core.health import sanitize_updates
 
-            updates, healthy = sanitize_updates(updates)
+            updates, healthy = sanitize_updates(updates, participation)
         elif self.forensics:
             # Non-destructive probe of sanitize_updates' predicate at the
             # SAME point in the round (pre-DP, pre-forge), so the
             # num_unhealthy metric means the same thing whether or not
             # health_check is recovering the lanes it counts.
             healthy = jnp.isfinite(updates).all(axis=-1)
+            if participation is not None:
+                healthy = healthy | ~participation
         updates = self.apply_dp(updates, k_dp)
 
         if self.adversary is not None and hasattr(self.adversary, "on_updates_ready"):
@@ -195,20 +241,36 @@ class FedRound:
         diag = None
         if self.forensics:
             server, agg, diag = self.server.step_diag(
-                state.server, updates, key=k_agg, trusted_update=trusted_update
+                state.server, updates, key=k_agg, trusted_update=trusted_update,
+                participation=participation,
             )
         else:
             server, agg = self.server.step(
-                state.server, updates, key=k_agg, trusted_update=trusted_update
+                state.server, updates, key=k_agg, trusted_update=trusted_update,
+                participation=participation,
             )
         benign = (~malicious).astype(jnp.float32)
+        if participation is not None:
+            # Loss and norm summaries cover the lanes that reported: a
+            # dropped lane's local round ran (shape regularity) but its
+            # numbers never reached the server.
+            benign = benign * participation.astype(jnp.float32)
+            norms = jnp.linalg.norm(updates, axis=1)
+            p = participation.astype(jnp.float32)
+            update_norm_mean = (norms * p).sum() / jnp.maximum(p.sum(), 1.0)
+        else:
+            update_norm_mean = jnp.linalg.norm(updates, axis=1).mean()
         train_loss = (losses * benign).sum() / jnp.maximum(benign.sum(), 1.0)
         metrics = {
             "train_loss": train_loss,
-            "update_norm_mean": jnp.linalg.norm(updates, axis=1).mean(),
+            "update_norm_mean": update_norm_mean,
             "agg_norm": jnp.linalg.norm(agg),
             "round": server.round,
         }
+        if self.faults is not None:
+            metrics["num_participating"] = participation.sum().astype(jnp.int32)
+            metrics["num_dropped"] = (~participation).sum().astype(jnp.int32)
+            metrics["num_straggled"] = straggled.sum().astype(jnp.int32)
         if self.health_check:
             from blades_tpu.core.health import guard_server_state
 
@@ -223,7 +285,9 @@ class FedRound:
             # ran, else the probe taken above at the same point — surfaced
             # instead of silently zeroed/ignored.
             healthy_mask = healthy
-            metrics.update(detection_metrics(diag["benign_mask"], malicious))
+            metrics.update(detection_metrics(
+                diag["benign_mask"], malicious, participation=participation
+            ))
             if not self.health_check:
                 metrics["num_unhealthy"] = (~healthy_mask).sum()
             # Per-lane bundle (prefix "lane_"): hosts split these from the
@@ -231,7 +295,7 @@ class FedRound:
             metrics["lane_benign_mask"] = diag["benign_mask"].astype(jnp.float32)
             metrics["lane_scores"] = diag["scores"].astype(jnp.float32)
             metrics["lane_healthy"] = healthy_mask.astype(jnp.float32)
-        return RoundState(server=server, client_opt=client_opt), metrics
+        return RoundState(server=server, client_opt=client_opt, stale=stale), metrics
 
     def multi_step(
         self,

@@ -135,6 +135,7 @@ class Server:
         *,
         key: Optional[jax.Array] = None,
         trusted_update: Optional[jax.Array] = None,
+        participation: Optional[jax.Array] = None,
     ) -> Tuple[ServerState, jax.Array]:
         """Aggregate the ``(n, d)`` update matrix and apply one server-opt step.
 
@@ -146,9 +147,21 @@ class Server:
         trust-bootstrapped aggregators (FLTrust) and appended as the final
         row of the matrix; passing a plain client matrix to FLTrust would
         make the last *client* the root of trust, so that is rejected.
+
+        ``participation`` is the chaos layer's ``(n,)`` bool mask
+        (:mod:`blades_tpu.faults`): when given, aggregation runs the
+        participation-aware ``masked_call`` path — which itself falls back
+        to the dense trace when every lane participates.  ``None`` (the
+        default) is the statically-dense path, literally unchanged.
         """
         updates = self._with_trusted_row(updates, trusted_update)
-        agg, agg_state = self.aggregator(updates, state.agg_state, key=key)
+        if participation is None:
+            agg, agg_state = self.aggregator(updates, state.agg_state, key=key)
+        else:
+            part = self._pad_participation(updates, participation)
+            agg, agg_state = self.aggregator.masked_call(
+                updates, part, state.agg_state, key=key
+            )
         return self.apply_aggregate(state, agg, agg_state), agg
 
     def step_diag(
@@ -158,18 +171,27 @@ class Server:
         *,
         key: Optional[jax.Array] = None,
         trusted_update: Optional[jax.Array] = None,
+        participation: Optional[jax.Array] = None,
     ) -> Tuple[ServerState, jax.Array, dict]:
         """:meth:`step` plus the aggregator's per-lane diagnostics bundle
         (see ``Aggregator.diagnose``) — ``(new_state, aggregate, diag)``.
         The diag arrays cover the CLIENT lanes of ``updates`` (FLTrust's
         appended trusted row judges, it is not judged), so they align with
-        the round's malicious/health masks.
+        the round's malicious/health masks.  With ``participation`` the
+        bundle comes from ``masked_diagnose`` and covers participating
+        lanes only.
         """
         n_clients = updates.shape[0]
         updates = self._with_trusted_row(updates, trusted_update)
-        agg, agg_state, diag = self.aggregator.diagnose(
-            updates, state.agg_state, key=key
-        )
+        if participation is None:
+            agg, agg_state, diag = self.aggregator.diagnose(
+                updates, state.agg_state, key=key
+            )
+        else:
+            part = self._pad_participation(updates, participation)
+            agg, agg_state, diag = self.aggregator.masked_diagnose(
+                updates, part, state.agg_state, key=key
+            )
         if diag["benign_mask"].shape[0] != n_clients:
             raise ValueError(
                 f"{self.aggregator.name} diagnostics cover "
@@ -178,6 +200,18 @@ class Server:
                 "client axis"
             )
         return self.apply_aggregate(state, agg, agg_state), agg, diag
+
+    def _pad_participation(
+        self, updates: jax.Array, participation: jax.Array
+    ) -> jax.Array:
+        """Extend the client participation mask with True for the trusted
+        row :meth:`_with_trusted_row` appended — the server's own update
+        always 'participates' (it is the yardstick, not a client)."""
+        if updates.shape[0] == participation.shape[0] + 1:
+            return jnp.concatenate(
+                [participation, jnp.ones((1,), participation.dtype)]
+            )
+        return participation
 
     def _with_trusted_row(
         self, updates: jax.Array, trusted_update: Optional[jax.Array]

@@ -1,0 +1,33 @@
+"""Chaos layer: deterministic fault injection + partial participation.
+
+The reference inherits its whole fault model from Ray
+(FaultTolerantActorManager marks actors unhealthy, Tune retries trials);
+the TPU-native port covers three failure layers instead, each at the
+granularity where a TPU deployment actually fails:
+
+- **lane** (:mod:`blades_tpu.core.health`): a client lane of the update
+  matrix goes non-finite — detected and zeroed inside the jitted round.
+- **round** (:mod:`blades_tpu.faults.injector`): clients drop out,
+  straggle (deliver updates staled by ``k`` rounds), or corrupt their
+  lane — a deterministic, seed-driven :class:`FaultInjector` composes
+  these processes inside the jitted round, and the aggregators degrade
+  gracefully over the dynamic participating-lane set
+  (``Aggregator.masked_call`` in :mod:`blades_tpu.ops.aggregators`).
+- **trial** (:mod:`blades_tpu.faults.host`): the host process is killed
+  or preempted — atomic checkpoint writes, backoff-with-jitter retries,
+  and a preemption simulation hook harden
+  :func:`blades_tpu.tune.sweep.run_experiments`.
+
+The injector is OFF by default: ``faults=None`` (plus, equivalently, full
+participation) leaves the round program literally unchanged — the dense
+aggregation trace runs and numerics are bit-identical to a build without
+this subsystem.
+"""
+
+from blades_tpu.faults.injector import FaultInjector  # noqa: F401
+from blades_tpu.faults.host import (  # noqa: F401
+    PreemptionHook,
+    SimulatedPreemption,
+    atomic_checkpoint,
+    retry_backoff,
+)

@@ -1,0 +1,193 @@
+"""Deterministic, seed-driven fault injection inside the jitted round.
+
+Real federated deployments are dominated by *partial participation*:
+clients drop out (device offline, network partition), straggle (deliver
+an update computed against a stale global model), or deliver corrupt
+lanes (overflowed local training, torn transfers).  ByzFL treats
+variable per-round cohorts as a first-class robustness dimension and
+BLADE-FL shows lazy/stale clients are an attack surface of their own
+(PAPERS.md) — so the failure process here is a frozen-dataclass config
+exactly like the aggregators: hashable static round config whose
+realizations are a pure function of ``(seed, round)``.
+
+Determinism contract: the fault PRNG stream is derived from
+``fold_in(PRNGKey(seed), round)`` — independent of the training key, so
+the SAME failure realization replays across retries, resumes, and
+execution modes.  A trial killed at round 40 and restored from its round
+30 checkpoint re-experiences rounds 31-40's faults identically.
+
+Three composable processes, all shape-static under jit:
+
+- **dropout**: per-round Bernoulli participation masks (or a
+  schedule-driven rate), with graceful degradation — an all-dropped
+  round degrades to full participation rather than aggregating nothing.
+- **stragglers**: ``num_stragglers`` participating lanes deliver the
+  update they computed ``staleness`` rounds ago, via a small ring buffer
+  threaded through :class:`~blades_tpu.core.round.RoundState`.
+- **corruption**: lanes overwritten with NaN/Inf/near-overflow values —
+  the faults :func:`blades_tpu.core.health.sanitize_updates` exists to
+  catch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+_CORRUPT_FILL = {
+    "nan": float("nan"),
+    "inf": float("inf"),
+    # Near-f32-max: finite on arrival, overflows to inf in the first
+    # squared-distance / squared-norm an aggregator computes — the
+    # corruption sanitize_updates does NOT catch, exercising the
+    # aggregate-level guard instead of the lane-level one.
+    "overflow": 3.0e38,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultInjector:
+    """Static chaos config; realizations are pure in ``(seed, round)``.
+
+    Attributes:
+        seed: fault-process seed, independent of the training key.
+        dropout_rate: per-round Bernoulli probability a client drops out.
+        dropout_schedule: optional ``((round, rate), ...)`` piecewise-
+            constant override — from each listed round on, dropout runs
+            at that rate (``dropout_rate`` applies before the first
+            entry).  Models diurnal cohorts and flash partitions.
+        num_stragglers: participating lanes per round that deliver the
+            update they computed ``staleness`` rounds ago (zeros until
+            the ring buffer warms up).
+        staleness: age, in rounds, of a straggler's delivered update.
+        corrupt_rate: per-round Bernoulli probability a PARTICIPATING
+            lane is overwritten with ``corrupt_mode`` garbage.
+        corrupt_mode: ``"nan" | "inf" | "overflow"``.
+    """
+
+    seed: int = 0
+    dropout_rate: float = 0.0
+    dropout_schedule: Optional[Tuple[Tuple[int, float], ...]] = None
+    num_stragglers: int = 0
+    staleness: int = 1
+    corrupt_rate: float = 0.0
+    corrupt_mode: str = "nan"
+
+    def __post_init__(self):
+        if not 0.0 <= self.dropout_rate < 1.0:
+            raise ValueError(
+                f"dropout_rate must be in [0, 1), got {self.dropout_rate} "
+                "(1.0 would drop every client every round)"
+            )
+        if not 0.0 <= self.corrupt_rate <= 1.0:
+            raise ValueError(f"corrupt_rate must be in [0, 1], got {self.corrupt_rate}")
+        if self.corrupt_mode not in _CORRUPT_FILL:
+            raise ValueError(
+                f"corrupt_mode must be one of {sorted(_CORRUPT_FILL)}, "
+                f"got {self.corrupt_mode!r}"
+            )
+        if self.num_stragglers < 0:
+            raise ValueError(f"num_stragglers must be >= 0, got {self.num_stragglers}")
+        if self.staleness < 1:
+            raise ValueError(f"staleness must be >= 1, got {self.staleness}")
+        if self.dropout_schedule is not None:
+            # Normalize to a tuple of (int, float) tuples: the injector is
+            # static jit config and must stay hashable (YAML hands us lists).
+            sched = tuple(sorted((int(r), float(v)) for r, v in self.dropout_schedule))
+            for r, v in sched:
+                if r < 0 or not 0.0 <= v < 1.0:
+                    raise ValueError(
+                        f"dropout_schedule entries must be (round >= 0, "
+                        f"rate in [0, 1)), got ({r}, {v})"
+                    )
+            object.__setattr__(self, "dropout_schedule", sched)
+
+    # -- static properties ---------------------------------------------------
+
+    @property
+    def needs_stale_buffer(self) -> bool:
+        """Whether :class:`~blades_tpu.core.round.RoundState` must carry
+        the ``(staleness, n, d)`` stale-update ring buffer."""
+        return self.num_stragglers > 0
+
+    # -- realizations --------------------------------------------------------
+
+    def round_key(self, round_idx: jax.Array) -> jax.Array:
+        """The fault PRNG key for one round — a pure function of
+        ``(seed, round)``, deliberately NOT derived from the training key
+        so retries/resumes replay identical failures."""
+        return jax.random.fold_in(jax.random.PRNGKey(self.seed), round_idx)
+
+    def dropout_rate_at(self, round_idx: jax.Array) -> jax.Array:
+        """Piecewise-constant dropout rate at ``round_idx`` (traced)."""
+        if not self.dropout_schedule:
+            return jnp.float32(self.dropout_rate)
+        bounds = jnp.asarray([r for r, _ in self.dropout_schedule], jnp.int32)
+        rates = jnp.asarray(
+            [self.dropout_rate] + [v for _, v in self.dropout_schedule], jnp.float32
+        )
+        return rates[jnp.searchsorted(bounds, round_idx, side="right")]
+
+    def init_stale_buffer(self, num_clients: int, num_params: int):
+        """Zeros ``(staleness, n, d)`` ring buffer (row ``-1`` is the
+        oldest), or None when no straggler process is configured."""
+        if not self.needs_stale_buffer:
+            return None
+        return jnp.zeros((self.staleness, num_clients, num_params), jnp.float32)
+
+    def inject(
+        self, updates: jax.Array, stale, round_idx: jax.Array
+    ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+        """Apply one round's failure realization to the update matrix.
+
+        Args:
+            updates: ``(n, d)`` fresh client updates.
+            stale: the ``(staleness, n, d)`` ring buffer from
+                :class:`~blades_tpu.core.round.RoundState` (None when no
+                straggler process is configured).
+            round_idx: scalar round counter (traced).
+
+        Returns:
+            ``(updates, stale, participation, straggled, corrupted)`` —
+            the faulted matrix, the advanced buffer, and the ``(n,)``
+            bool masks.  ``participation`` is guaranteed non-empty: an
+            all-dropped draw degrades to full participation (an empty
+            round has no aggregate; the metrics still record the draw via
+            the dropout stream's determinism).
+        """
+        n = updates.shape[0]
+        k_drop, k_strag, k_corr = jax.random.split(self.round_key(round_idx), 3)
+
+        participation = jax.random.uniform(k_drop, (n,)) >= self.dropout_rate_at(round_idx)
+        participation = jnp.where(
+            participation.any(), participation, jnp.ones_like(participation)
+        )
+
+        straggled = jnp.zeros((n,), bool)
+        if self.needs_stale_buffer:
+            # The num_stragglers lowest-scoring participants deliver the
+            # buffer's oldest row (their own update from `staleness`
+            # rounds ago); the buffer then advances with THIS round's
+            # fresh updates, so a lane straggling twice in a row still
+            # replays what it truly computed, not a stale copy of a copy.
+            scores = jnp.where(
+                participation, jax.random.uniform(k_strag, (n,)), jnp.inf
+            )
+            rank = jnp.argsort(jnp.argsort(scores))
+            straggled = (rank < self.num_stragglers) & participation
+            fresh = updates
+            updates = jnp.where(straggled[:, None], stale[-1], updates)
+            stale = jnp.concatenate([fresh[None], stale[:-1]], axis=0)
+
+        corrupted = jnp.zeros((n,), bool)
+        if self.corrupt_rate > 0.0:
+            corrupted = (
+                jax.random.uniform(k_corr, (n,)) < self.corrupt_rate
+            ) & participation
+            fill = jnp.full_like(updates, _CORRUPT_FILL[self.corrupt_mode])
+            updates = jnp.where(corrupted[:, None], fill, updates)
+
+        return updates, stale, participation, straggled, corrupted

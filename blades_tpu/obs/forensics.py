@@ -24,31 +24,47 @@ import jax.numpy as jnp
 
 
 def detection_metrics(
-    benign_mask: jax.Array, malicious: jax.Array
+    benign_mask: jax.Array,
+    malicious: jax.Array,
+    participation: jax.Array = None,
 ) -> Dict[str, jax.Array]:
     """Confusion-matrix scalars for one round's lane decision.
 
     Args:
         benign_mask: ``(n,)`` bool — lanes the aggregator kept.
         malicious: ``(n,)`` bool — ground-truth Byzantine lanes.
+        participation: optional ``(n,)`` bool mask from the chaos layer
+            (:mod:`blades_tpu.faults`).  When given, the confusion matrix
+            is CONDITIONED on participation: only lanes that delivered an
+            update this round are scored.  A malicious client that
+            dropped out was neither caught nor missed — counting it as a
+            miss would penalize the defense for lanes it never saw.
 
     Returns:
         dict of f32/int32 device scalars:
         ``byz_precision`` — of the flagged lanes, fraction truly malicious
         (1.0 when nothing is flagged: no false alarms);
-        ``byz_recall`` — of the malicious lanes, fraction flagged
-        (1.0 when there are no malicious lanes to catch);
-        ``byz_fpr`` — fraction of benign lanes falsely flagged;
+        ``byz_recall`` — of the (participating) malicious lanes, fraction
+        flagged (1.0 when there are none to catch);
+        ``byz_fpr`` — fraction of (participating) benign lanes falsely
+        flagged;
         ``num_flagged`` — int32 count of flagged lanes.
     """
     flagged = ~benign_mask.astype(bool)
     mal = malicious.astype(bool)
+    if participation is not None:
+        part = participation.astype(bool)
+        flagged = flagged & part
+        mal = mal & part
+        n_benign_lanes = part & ~mal
+    else:
+        n_benign_lanes = ~mal
     f32 = jnp.float32
     tp = (flagged & mal).sum().astype(f32)
     fp = (flagged & ~mal).sum().astype(f32)
     n_flagged = tp + fp
     n_mal = mal.sum().astype(f32)
-    n_benign = (~mal).sum().astype(f32)
+    n_benign = n_benign_lanes.sum().astype(f32)
     return {
         "byz_precision": jnp.where(n_flagged > 0, tp / jnp.maximum(n_flagged, 1.0), 1.0),
         "byz_recall": jnp.where(n_mal > 0, tp / jnp.maximum(n_mal, 1.0), 1.0),

@@ -143,6 +143,8 @@ class StdoutSink(Sink):
         for key, fmt in (("train_loss", "loss={:.4f}"), ("test_acc", "acc={:.4f}"),
                          ("byz_precision", "byzP={:.2f}"),
                          ("byz_recall", "byzR={:.2f}"),
+                         ("num_participating", "part={}"),
+                         ("num_straggled", "stale={}"),
                          ("num_unhealthy", "unhealthy={}")):
             if key in record:
                 parts.append(fmt.format(record[key]))

@@ -50,6 +50,15 @@ ROUND_RECORD_FIELDS: Dict[str, Tuple[tuple, bool]] = {
     # health (core/health.py)
     "num_unhealthy": ((int,), False),
     "round_ok": ((bool,), False),
+    # chaos layer (blades_tpu/faults): per-round participation telemetry.
+    # When these are present, the detection metrics below are CONDITIONED
+    # on participation — byz_precision/recall/fpr score only the lanes
+    # that delivered an update this round (a dropped malicious client was
+    # neither caught nor missed).
+    "num_participating": ((int,), False),
+    "num_straggled": ((int,), False),
+    "num_dropped": ((int,), False),
+    "fault_seed": ((int,), False),
     # defense forensics (obs/forensics.py)
     "byz_precision": (_NUM, False),
     "byz_recall": (_NUM, False),

@@ -25,6 +25,17 @@ computed by the SAME trace as ``__call__`` — selection aggregators derive
 both from one shared selection — so enabling diagnostics cannot change
 numerics, and when the diag outputs are unused XLA dead-code-eliminates
 them (zero overhead when disabled).
+
+**Partial participation** (chaos layer, :mod:`blades_tpu.faults`): every
+aggregator also exposes ``masked_call``/``masked_diagnose`` taking an
+``(n,)`` participation mask.  A full-participation mask dispatches (via
+``lax.cond``) to the EXACT dense trace — bit-identical numerics — while a
+round with dropout runs the masked formulation: Mean/Median renormalize
+over active lanes, Trimmedmean/Multikrum/DnC recompute their
+trim/neighbour/keep counts against the dynamic active-lane count, FLTrust
+zeroes dropped clients' trust, and the rest degrade gracefully by
+imputing dropped rows with the active-lane coordinate-wise median (a
+robust center — the active mean is corruptible) before the dense path.
 """
 
 from __future__ import annotations
@@ -105,6 +116,81 @@ class Aggregator:
         agg, diag = self.aggregate_diag(updates)
         return agg, state, diag
 
+    # -- partial participation (chaos layer, blades_tpu/faults) --------------
+
+    def masked_call(
+        self,
+        updates: jax.Array,
+        participation: jax.Array,
+        state: AggState = (),
+        *,
+        key: Optional[jax.Array] = None,
+    ) -> Tuple[jax.Array, AggState]:
+        """Participation-aware ``__call__``: aggregate over the lanes where
+        ``participation`` (``(n,)`` bool) is True.
+
+        Dispatched through ``lax.cond`` so a full-participation round
+        takes the EXACT dense ``__call__`` trace — numerics bit-identical
+        to a build without the chaos layer — and only a round with real
+        dropout pays the masked formulation (``_masked``).
+        """
+        return lax.cond(
+            participation.all(),
+            lambda: self(updates, state, key=key),
+            lambda: self._masked(updates, participation, state, key=key)[:2],
+        )
+
+    def masked_diagnose(
+        self,
+        updates: jax.Array,
+        participation: jax.Array,
+        state: AggState = (),
+        *,
+        key: Optional[jax.Array] = None,
+    ) -> Tuple[jax.Array, AggState, LaneDiag]:
+        """:meth:`masked_call` plus the per-lane diagnostics bundle; the
+        same all-True fast path applies.  Under dropout the benign_mask
+        covers participating lanes only — a dropped lane was never
+        judged, so it is not "kept"."""
+        return lax.cond(
+            participation.all(),
+            lambda: self.diagnose(updates, state, key=key),
+            lambda: self._masked(updates, participation, state, key=key),
+        )
+
+    def _masked(
+        self,
+        updates: jax.Array,
+        participation: jax.Array,
+        state: AggState = (),
+        *,
+        key: Optional[jax.Array] = None,
+    ) -> Tuple[jax.Array, AggState, LaneDiag]:
+        """Masked-branch body: ``(aggregate, new_state, diag)`` over the
+        participating lanes.
+
+        Default GRACEFUL DEGRADATION for aggregators without a native
+        partial-participation formulation (GeoMed, Centeredclipping,
+        Signguard, Clippedclustering): dropped rows are imputed with the
+        active-lane coordinate-wise MEDIAN, then the dense path runs on
+        the imputed matrix.  The median — not the mean — on purpose: the
+        active mean is itself corruptible (f Byzantine rows at 100x drag
+        it to the attack point, and imputing k dropped lanes with it
+        mints k COPIES of the poison — measured to capture GeoMed's
+        majority under 30% dropout), while the masked median is a robust
+        center, so imputed rows land inside the benign cluster.
+        Mean/Median/Trimmedmean/Multikrum/DnC override this with exact
+        masked formulations whose trim/selection counts track the dynamic
+        active-lane count.
+        """
+        fill = masked.masked_median(updates, participation)
+        filled = jnp.where(participation[:, None], updates, fill[None, :])
+        agg, new_state, diag = self.diagnose(filled, state, key=key)
+        bm = diag["benign_mask"]
+        if bm.shape[0] == participation.shape[0]:
+            diag = lane_diag(bm & participation, diag["scores"])
+        return agg, new_state, diag
+
     @property
     def name(self) -> str:
         return type(self).__name__
@@ -116,6 +202,13 @@ class Mean(Aggregator):
 
     def aggregate(self, updates: jax.Array) -> jax.Array:
         return updates.mean(axis=0)
+
+    def _masked(self, updates, participation, state=(), *, key=None):
+        """Renormalize over active lanes: sum of participants / m."""
+        del key
+        agg = masked.masked_mean(updates, participation)
+        scores = jnp.linalg.norm(updates - agg[None, :], axis=1)
+        return agg, state, lane_diag(participation, scores)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -132,6 +225,13 @@ class Median(Aggregator):
         if pallas_select.should_use(updates):
             return pallas_select.column_median(updates)
         return masked.median(updates)
+
+    def _masked(self, updates, participation, state=(), *, key=None):
+        """Median of the dynamic active-lane set (masked order statistics)."""
+        del key
+        agg = masked.masked_median(updates, participation)
+        scores = jnp.linalg.norm(updates - agg[None, :], axis=1)
+        return agg, state, lane_diag(participation, scores)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -179,6 +279,23 @@ class Trimmedmean(Aggregator):
         trimmed = (ranks < k) | (ranks >= n - k)
         frac = trimmed.mean(axis=1, dtype=jnp.float32)
         return agg, lane_diag(frac < 0.5, frac)
+
+    def _masked(self, updates, participation, state=(), *, key=None):
+        """Trim window recomputed against the DYNAMIC active count ``m``:
+        the static ``num_excluded`` is clamped to ``(m - 1) // 2`` so at
+        least one lane always survives the trim, and the per-coordinate
+        window is ``[k, m - k)`` over the active-sorted column."""
+        del key
+        m = participation.sum()
+        k = jnp.clip(self.num_excluded, 0, jnp.maximum((m - 1) // 2, 0))
+        agg = masked.masked_trimmed_mean(updates, participation, k)
+        # Diag mirrors the dense trim-fraction score, ranked among ACTIVE
+        # lanes only (+inf pushes dropped rows past the window).
+        xs = jnp.where(participation[:, None], updates, jnp.inf)
+        ranks = jnp.argsort(jnp.argsort(xs, axis=0), axis=0)
+        trimmed = (ranks < k) | ((ranks >= m - k) & (ranks < m))
+        frac = trimmed.mean(axis=1, dtype=jnp.float32)
+        return agg, state, lane_diag(participation & (frac < 0.5), frac)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -298,6 +415,40 @@ class DnC(Aggregator):
         benign, scores = self._select(updates, key)
         return masked.masked_mean(updates, benign), state, lane_diag(benign, scores)
 
+    def _masked(self, updates, participation, state=(), *, key=None):
+        """Keep-count recomputed against the DYNAMIC active count:
+        ``keep = clip(m - filter_frac * f, 1, m)`` instead of the static
+        ``n - filter_frac * f``.  Dropped lanes are zeroed out of the
+        centered matrix (so they cannot steer the singular vector) and
+        scored +inf (so they never rank into the keep-set)."""
+        if key is None:
+            raise ValueError(
+                "DnC requires a PRNG key: a fixed coordinate subsample would "
+                "let an adaptive adversary hide poison in never-sampled "
+                "coordinates (pass key= per round)"
+            )
+        n, d = updates.shape
+        sub_dim = min(self.sub_dim, d)
+        m = participation.sum()
+        keep = jnp.clip(m - int(self.filter_frac * self.num_byzantine), 1, m)
+
+        def one_iter(k):
+            idx = jax.random.permutation(k, d)[:sub_dim]
+            sub = updates[:, idx]
+            mu = masked.masked_mean(sub, participation)
+            centered = jnp.where(participation[:, None], sub - mu, 0.0)
+            v = jnp.linalg.svd(centered, full_matrices=False)[2][0]
+            s = (centered @ v) ** 2
+            rank = jnp.argsort(jnp.argsort(jnp.where(participation, s, jnp.inf)))
+            return (rank < keep) & participation, s
+
+        keys = jax.random.split(key, self.num_iters)
+        benign_iters, scores_iters = jax.vmap(one_iter)(keys)
+        benign = jnp.any(benign_iters, axis=0)
+        benign = jnp.where(benign.any(), benign, participation)
+        scores = jnp.where(participation, scores_iters.mean(axis=0), 0.0)
+        return masked.masked_mean(updates, benign), state, lane_diag(benign, scores)
+
 
 @dataclasses.dataclass(frozen=True)
 class Multikrum(Aggregator):
@@ -340,6 +491,34 @@ class Multikrum(Aggregator):
 
     def aggregate(self, updates: jax.Array) -> jax.Array:
         return self.aggregate_diag(updates)[0]
+
+    def _masked(self, updates, participation, state=(), *, key=None):
+        """Neighbour and selection counts recomputed against the DYNAMIC
+        active count ``m``: score = sum of the ``max(m - f - 2, 1)``
+        smallest squared distances to other ACTIVE clients (dropped lanes
+        are +inf in the distance matrix, so they are never neighbours and
+        never selected); aggregate = mean of the ``min(k, m)``
+        lowest-scoring active lanes."""
+        del key
+        n = updates.shape[0]
+        f = self.num_byzantine
+        m = participation.sum()
+        q = jnp.maximum(m - f - 2, 1)
+        sq = jnp.sum(updates**2, axis=1)
+        d2 = sq[:, None] + sq[None, :] - 2.0 * (updates @ updates.T)
+        d2 = jnp.maximum(d2, 0.0)
+        out = ~participation
+        d2 = jnp.where(
+            jnp.eye(n, dtype=bool) | out[:, None] | out[None, :], jnp.inf, d2
+        )
+        sortd = jnp.sort(d2, axis=1)
+        neigh = jnp.arange(n)[None, :] < q
+        # where (not multiply): 0 * inf in the padded tail would be NaN.
+        scores = jnp.where(neigh, sortd, 0.0).sum(axis=1)
+        rank = jnp.argsort(jnp.argsort(scores))
+        mask = (rank < jnp.minimum(self.k, m)) & participation
+        mask = jnp.where(mask.any(), mask, participation)
+        return masked.masked_mean(updates, mask), state, lane_diag(mask, scores)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -573,6 +752,24 @@ class FLTrust(Aggregator):
 
     def aggregate(self, updates: jax.Array) -> jax.Array:
         return self.aggregate_diag(updates)[0]
+
+    def _masked(self, updates, participation, state=(), *, key=None):
+        """A dropped client gets trust 0 — excluded from the trust-weighted
+        sum exactly as a lane that never reported.  ``participation``
+        arrives padded with True for the appended trusted row (the server
+        always has its own root-data update); the diag covers the client
+        rows, as in the dense path."""
+        del key
+        server = updates[-1]
+        clients = updates[:-1]
+        part = participation[:-1]
+        s_norm = jnp.linalg.norm(server)
+        c_norm = jnp.maximum(jnp.linalg.norm(clients, axis=1), 1e-12)
+        cos = (clients @ server) / (c_norm * jnp.maximum(s_norm, 1e-12))
+        trust = jax.nn.relu(cos) * part.astype(cos.dtype)
+        rescaled = clients * (s_norm / c_norm)[:, None]
+        agg = (trust[:, None] * rescaled).sum(axis=0) / jnp.maximum(trust.sum(), 1e-12)
+        return agg, state, lane_diag((trust > 0.0) & part, cos)
 
 
 AGGREGATORS = {

@@ -59,6 +59,29 @@ def median(x: jax.Array) -> jax.Array:
     return masked_median(x, jnp.ones(x.shape[0], dtype=bool))
 
 
+def masked_trimmed_mean(x: jax.Array, mask: jax.Array, k: jax.Array) -> jax.Array:
+    """Coordinate-wise trimmed mean over selected rows with a DYNAMIC trim
+    count ``k`` (a traced scalar, already clamped so ``2k < m`` where
+    ``m = mask.sum()``).
+
+    Unselected rows are pushed to +inf so each column sorts its ``m``
+    active values first; the mean runs over sorted ranks ``[k, m - k)``.
+    This is the participation-aware form of
+    :meth:`~blades_tpu.ops.aggregators.Trimmedmean.aggregate` — the trim
+    window tracks the dynamic active-lane count instead of the static
+    client count.  An empty mask falls back to all rows (see
+    ``_nonempty``).
+    """
+    mask = _nonempty(mask)
+    m = mask.sum()
+    xs = jnp.sort(jnp.where(mask[:, None], x, jnp.inf), axis=0)
+    idx = jnp.arange(x.shape[0])
+    win = (idx >= k) & (idx < m - k)
+    # where (not multiply): the +inf pad rows must not turn 0*inf into NaN.
+    kept = jnp.where(win[:, None], xs, 0.0)
+    return kept.sum(axis=0) / jnp.maximum(m - 2 * k, 1)
+
+
 def clip_rows_to_norm(x: jax.Array, max_norm: jax.Array, eps: float = 1e-12) -> jax.Array:
     """Scale each row of ``x`` (n, d) down to L2 norm ``max_norm`` if above it.
 

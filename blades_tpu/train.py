@@ -56,7 +56,16 @@ def main(argv=None) -> int:
     p_file.add_argument("--max-failures", type=int, default=0,
                         help="retry a crashed trial from its latest "
                         "checkpoint up to N times, then mark it failed and "
-                        "keep sweeping (Tune's trial fault tolerance)")
+                        "keep sweeping (Tune's trial fault tolerance); "
+                        "restarts back off exponentially with deterministic "
+                        "jitter")
+    p_file.add_argument("--preempt-after", type=int, default=None,
+                        metavar="N",
+                        help="chaos test hook: raise a SimulatedPreemption "
+                        "once, the first time a trial finishes round N "
+                        "(between the result write and the checkpoint "
+                        "save), exercising kill-and-resume end-to-end; "
+                        "combine with --max-failures or --resume")
     p_file.add_argument("--coordinator", default=None, metavar="HOST:PORT",
                         help="multi-host bring-up via jax.distributed — the "
                         "TPU-native replacement for the reference's NCCL "
@@ -102,6 +111,7 @@ def main(argv=None) -> int:
                 resume=args.resume,
                 max_rounds_override=args.max_rounds,
                 max_failures=args.max_failures,
+                preempt_after=args.preempt_after,
                 lanes=not args.no_lanes,
                 metrics_csv=args.metrics_csv,
                 cost_analysis=not args.no_cost_analysis,

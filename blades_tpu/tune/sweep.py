@@ -170,6 +170,10 @@ def _lanes_eligible(spec_run: str, trial: Dict, group: List[int]) -> bool:
         # trial would silently drop the per-lane telemetry the user asked
         # for, so it runs sequentially.
         return False
+    if getattr(cfg, "fault_config", None):
+        # Same for the chaos layer: the laned program has no fault
+        # injection, so a faulted trial would silently run failure-free.
+        return False
     if cfg.lr_schedule:
         _, ov = _lane_signature(trial)
         if "server_lr" in ov:
@@ -287,12 +291,48 @@ def _truncate_csv(path: Path, upto_round: int) -> None:
 
 
 def _latest_checkpoint(tdir: Path) -> Optional[Path]:
-    """Newest periodic checkpoint by round number (``ckpt_<round>``)."""
-    ckpts = sorted(
-        (p for p in tdir.glob("ckpt_*") if p.name != "ckpt_final"),
-        key=lambda p: p.name,
-    )
+    """Newest periodic checkpoint by round number (``ckpt_<round>``).
+
+    Orphaned ``ckpt_*.tmp`` directories — an atomic checkpoint write
+    (:func:`blades_tpu.faults.host.atomic_checkpoint`) that a SIGKILL
+    interrupted before its ``os.replace`` — are DELETED here, never
+    restored: their contents are of unknown completeness, and the
+    previous published checkpoint is the newest trustworthy state.
+    """
+    import shutil
+
+    ckpts = []
+    for p in tdir.glob("ckpt_*"):
+        if p.name.endswith(".tmp"):
+            shutil.rmtree(p, ignore_errors=True)
+        elif p.name != "ckpt_final":
+            ckpts.append(p)
+    ckpts.sort(key=lambda p: p.name)
     return ckpts[-1] if ckpts else None
+
+
+def verify_result_rounds(path) -> List[int]:
+    """The no-duplicate/no-gap round-sequence check for a trial's
+    ``result.json``: ``training_iteration`` must be strictly increasing
+    with a uniform stride (1, or ``rounds_per_dispatch``).  A resume that
+    restored a stale checkpoint without truncating, or skipped rounds,
+    fails here.  Returns the iteration list on success, raises
+    ``ValueError`` otherwise."""
+    rows = _read_results(Path(path))
+    its = [r.get("training_iteration") for r in rows]
+    if any(i is None for i in its):
+        raise ValueError(f"{path}: rows missing training_iteration")
+    if not its:
+        return its
+    stride = its[1] - its[0] if len(its) > 1 else 1
+    expected = list(range(its[0], its[0] + stride * len(its), stride))
+    if stride < 1 or its != expected:
+        raise ValueError(
+            f"{path}: round sequence has duplicates or gaps: {its[:20]}..."
+            if len(its) > 20 else
+            f"{path}: round sequence has duplicates or gaps: {its}"
+        )
+    return its
 
 
 def _prune_checkpoints(
@@ -414,6 +454,9 @@ def run_experiments(
     heartbeat_every: int = 10,
     cost_analysis: bool = True,
     strict_metrics: bool = True,
+    retry_backoff_base: float = 0.5,
+    retry_backoff_cap: float = 30.0,
+    preempt_after: Optional[int] = None,
 ) -> List[Dict]:
     """Run every trial of every experiment; returns summaries.
 
@@ -468,11 +511,31 @@ def run_experiments(
     raises is restarted from its latest periodic checkpoint up to
     ``max_failures`` times (the error is appended to ``error.txt`` in the
     trial dir); a trial that exhausts its retries is marked failed in the
-    summary and the REMAINING trials still run.
+    summary and the REMAINING trials still run.  Restarts back off
+    exponentially (``retry_backoff_base`` doubling up to
+    ``retry_backoff_cap`` seconds) with deterministic jitter seeded from
+    the trial — immediate restarts would hammer a persistently failing
+    trial (see :func:`blades_tpu.faults.host.retry_backoff`).
+
+    **Checkpoint durability** (chaos layer, :mod:`blades_tpu.faults.host`):
+    every checkpoint save is atomic — written to ``ckpt_<round>.tmp``,
+    fsynced, then published by one ``os.replace``.  A SIGKILL landing
+    mid-write leaves at worst an orphaned ``.tmp`` that restore deletes;
+    ``_latest_checkpoint`` can never hand a torn checkpoint to
+    ``load_checkpoint``.  ``preempt_after=N`` is the test hook for
+    exactly that path: the sweep raises a ``SimulatedPreemption`` once,
+    the first time a trial finishes round N (between the result-row write
+    and the checkpoint save), so kill-and-resume — crash, backoff,
+    restore from an OLDER checkpoint, truncate, re-run with no duplicated
+    or skipped rounds — is exercised end-to-end without a real SIGKILL.
     """
     from blades_tpu.algorithms import get_algorithm_class
+    from blades_tpu.faults.host import (PreemptionHook, atomic_checkpoint,
+                                        retry_backoff)
     from blades_tpu.obs import CsvSink, JsonlSink, MetricsLogger, StdoutSink
     from blades_tpu.utils.timers import Timers
+
+    preempt_hook = PreemptionHook(preempt_after) if preempt_after else None
 
     root = Path(storage_path).expanduser()
     summaries = []
@@ -607,10 +670,17 @@ def run_experiments(
                             f.write(json.dumps(row) + "\n")
                             logger.log(row)
                             best_acc = max(best_acc, result.get("test_acc", 0.0))
+                            if preempt_hook is not None:
+                                # Fires BETWEEN the row write and the
+                                # checkpoint save — the widest window a
+                                # real preemption lands in, so restore
+                                # must come from an older checkpoint.
+                                preempt_hook.check(algo.iteration)
                             if checkpoint_freq and algo.iteration % checkpoint_freq == 0:
                                 name = f"ckpt_{algo.iteration:06d}"
                                 with timers.time("checkpoint"):
-                                    algo.save_checkpoint(str(tdir / name))
+                                    atomic_checkpoint(algo.save_checkpoint,
+                                                      tdir / name)
                                 ckpt_scores[name] = float(
                                     result.get(checkpoint_score_attr, algo.iteration)
                                 )
@@ -638,6 +708,17 @@ def run_experiments(
                             print(f"   !! trial {tname} FAILED after "
                                   f"{failures} attempt(s): {exc!r}", flush=True)
                         break
+                    # Exponential backoff with deterministic jitter before
+                    # the restart: an immediate retry of a persistently
+                    # failing trial hammers it (and whatever shared
+                    # resource it is failing against) at full speed.
+                    delay = retry_backoff(
+                        failures,
+                        trial_seed=f"{tname}:{trial_cfg.get('seed', 0)}",
+                        base=retry_backoff_base, cap=retry_backoff_cap,
+                    )
+                    if delay > 0:
+                        time.sleep(delay)
                     # Fresh build + restore from the latest checkpoint, the
                     # reference's restart-from-checkpoint trial retry.
                     _, config = get_algorithm_class(spec["run"], return_config=True)
@@ -659,7 +740,7 @@ def run_experiments(
                         logger.close()
             if checkpoint_at_end and failed_error is None:
                 with timers.time("checkpoint"):
-                    algo.save_checkpoint(str(tdir / "ckpt_final"))
+                    atomic_checkpoint(algo.save_checkpoint, tdir / "ckpt_final")
             wall = time.perf_counter() - t0
             new_rounds = algo.iteration - start_round
             # Sweep-level phase timings (satellite: compile / round / eval /
