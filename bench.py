@@ -313,6 +313,76 @@ def bench_workload(model: str, num_clients: int, client_block: int,
     }
 
 
+def _cpu_fallback(probe_err: str) -> None:
+    """The relay-dead-box path: measure a REDUCED configuration of the
+    same pipeline (FedAvg + ALIE forge + exact Median, dense round, CPU
+    backend) so the perf trajectory stays populated with a real number
+    instead of ``value: null`` (every BENCH_r0*.json so far is
+    ``backend_unavailable``).  The config is fixed — 32 clients x the
+    reference CNN, 3 timed rounds, ~4-6 min end to end on a 2-core box
+    (measured; the 1500 s watchdog holds with margin) — so cpu_fallback
+    values are comparable ACROSS rounds with each other, never with TPU
+    values; the ``backend`` tag and the probe failure in ``detail``
+    keep the two series separable."""
+    # Force the CPU backend BEFORE first backend init: sitecustomize sets
+    # jax_platforms="axon,cpu", and a flapping axon plugin hangs instead
+    # of failing fast — the exact pathology the probe subprocess exists
+    # to contain (it must not recur in-process here).
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    from blades_tpu.adversaries import get_adversary, make_malicious_mask
+    from blades_tpu.core import FedRound, Server, TaskSpec
+
+    num_clients, num_byzantine, timed_rounds = 32, 8, 3
+    task = TaskSpec(model="cnn", input_shape=(32, 32, 3), num_classes=10,
+                    lr=0.1).build()
+    server = Server.from_config(aggregator="Median", lr=0.5)
+    adv = get_adversary("ALIE", num_clients=num_clients,
+                        num_byzantine=num_byzantine)
+    fr = FedRound(task=task, server=server, adversary=adv, batch_size=BATCH,
+                  num_batches_per_round=LOCAL_STEPS)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(num_clients, SHARD, 32, 32, 3)),
+                    jnp.float32)
+    y = jnp.asarray(rng.integers(0, 10, size=(num_clients, SHARD)), jnp.int32)
+    lengths = jnp.full((num_clients,), SHARD, jnp.int32)
+    mal = make_malicious_mask(num_clients, num_byzantine)
+    state = fr.init(jax.random.PRNGKey(0), num_clients)
+    step = jax.jit(fr.step, donate_argnums=(0,))
+
+    state, m = step(state, x, y, lengths, mal, jax.random.PRNGKey(1))
+    _ = float(m["train_loss"])  # compile + settle
+    t0 = time.perf_counter()
+    for r in range(timed_rounds):
+        state, metrics = step(state, x, y, lengths, mal,
+                              jax.random.fold_in(jax.random.PRNGKey(2), r))
+    final_loss = float(metrics["train_loss"])
+    assert final_loss == final_loss  # NaN guard
+    dt = time.perf_counter() - t0
+    rps = timed_rounds / dt
+    d = sum(p.size for p in jax.tree.leaves(state.server.params))
+    _emit({
+        "metric": METRIC_NAME,
+        "value": round(rps, 4),
+        "unit": "rounds/s",
+        "vs_baseline": None,
+        "backend": "cpu_fallback",
+        "detail": f"TPU probe failed ({probe_err[-400:]}); measured the "
+                  "reduced cpu_fallback config instead — comparable only "
+                  "with other cpu_fallback rounds",
+        "config": {
+            "clients": num_clients, "byzantine": num_byzantine,
+            "model": "cnn", "params": d, "batch": BATCH,
+            "local_steps": LOCAL_STEPS, "timed_rounds": timed_rounds,
+            "aggregator": "Median", "adversary": "ALIE",
+            "path": "dense_cpu",
+        },
+    })
+
+
 def main() -> None:
     # Armed from process start (covers the probe too): rounds 1-3's happy
     # path finished in well under 25 min, and round 4's driver kill came
@@ -323,8 +393,18 @@ def main() -> None:
         total_budget_s=float(os.environ.get("BLADES_BENCH_PROBE_BUDGET_S",
                                             "300")))
     if err is not None:
-        _emit(_error_json("backend_unavailable", err))
-        sys.exit(2)
+        # Relay-dead box: fall back to a CPU measurement (tagged
+        # cpu_fallback, probe failure preserved in detail) rather than
+        # emitting value: null — the perf trajectory stays populated.
+        try:
+            _cpu_fallback(err)
+            sys.exit(0)
+        except Exception as e:
+            _emit(_error_json(
+                "backend_unavailable",
+                f"{err}; cpu_fallback also failed: "
+                f"{type(e).__name__}: {e}"))
+            sys.exit(2)
 
     try:
         r10 = bench_workload("resnet10", 1000, 50, timed_rounds=5)
@@ -337,6 +417,7 @@ def main() -> None:
         "metric": METRIC_NAME,
         "value": r10["rounds_per_sec"],
         "unit": "rounds/s",
+        "backend": "tpu",
         "vs_baseline": round(r10["rounds_per_sec"] / BASELINE_EST_ROUNDS_PER_SEC, 2),
         "baseline": {
             "rounds_per_sec": BASELINE_EST_ROUNDS_PER_SEC,

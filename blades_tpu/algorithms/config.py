@@ -78,6 +78,27 @@ class FedavgConfig:
         self.compute_dtype: Any = None
         # rounds fused per device dispatch (lax.scan); 1 = round-per-call
         self.rounds_per_dispatch: int = 1
+        # chained_dispatch: with rounds_per_dispatch > 1, derive each
+        # scanned round's key by split-chaining the driver's carry
+        # (multi_step_chained) instead of multi_step's one-shot
+        # split(key, n) fan.  Rounds are then bit-identical to
+        # round-per-dispatch execution — the sweep's scan-window mode
+        # sets this.  Dense/streamed single-chip paths only.
+        self.chained_dispatch: bool = False
+        # round-pipeline perf layer (blades_tpu/perf):
+        # donate_buffers: donate RoundState into each dense dispatch —
+        # the stacked client opt states are updated in place instead of
+        # copied (halves peak HBM for the largest tensors on that path).
+        # Callers must then treat the pre-step state as consumed; see
+        # README "Performance".  False restores copying semantics.
+        self.donate_buffers: bool = True
+        # prefetch: stage the next round's per-client batches while the
+        # current round computes (data/prefetch.py).  "auto" (default)
+        # = on for the dense single-round dispatch on an accelerator
+        # backend (CPU has no overlap to win, so auto skips the second
+        # program there); True forces, False disables.  Bit-transparent
+        # either way.
+        self.prefetch: Any = "auto"
         # execution path: "auto" | "dense" | "streamed".  "streamed" runs
         # the single-chip streaming round (parallel/streamed.py) whose
         # bf16 (n, d) update matrix + block dispatches fit giant
@@ -339,6 +360,17 @@ class FedavgConfig:
             raise ValueError(
                 f"update_dtype must be 'bfloat16' or 'float32', got "
                 f"{self.update_dtype!r}"
+            )
+        if self.chained_dispatch and self.num_devices and self.num_devices > 1:
+            raise ValueError(
+                "chained_dispatch (the sweep's scan-window key discipline) "
+                "has no mesh formulation; run without num_devices or drop "
+                "chained_dispatch"
+            )
+        if self.prefetch not in ("auto", "on", "off", True, False):
+            raise ValueError(
+                f"prefetch must be 'auto', True or False, got "
+                f"{self.prefetch!r}"
             )
         if self.d_chunk < 1024:
             raise ValueError(f"d_chunk must be >= 1024, got {self.d_chunk}")

@@ -20,14 +20,16 @@ from __future__ import annotations
 import pickle
 import time
 from pathlib import Path
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from blades_tpu.adversaries import make_malicious_mask
 from blades_tpu.core import FedRound
 from blades_tpu.data import DatasetCatalog
+from blades_tpu.perf.async_metrics import DEVICE_METRICS_KEY
 from blades_tpu.utils.timers import Timers
 
 
@@ -89,6 +91,13 @@ class Fedavg:
         self._test_arrays = (tx, ty, tln)
 
         self._chunk = max(1, int(getattr(cfg, "rounds_per_dispatch", 1)))
+        # Chained key discipline (multi_step_chained): each scanned round
+        # consumes split(carry) exactly like the sequential driver, so
+        # windowed rounds are bit-identical to round-per-dispatch ones.
+        self._chained = (bool(getattr(cfg, "chained_dispatch", False))
+                         and self._chunk > 1)
+        self._prefetcher = None   # set by _setup_dense_pipeline when active
+        self._cache_wrappers = []  # CachedFunctions feeding the obs counters
         self.mesh = None
         # Client permutation applied to the stacked arrays (d-sharded
         # elision layout); None = natural order.  Checkpoints record it
@@ -179,25 +188,141 @@ class Fedavg:
                 from blades_tpu.parallel.streamed import streamed_multi_step
 
                 self._step = streamed_multi_step(
-                    self.fed_round, self._chunk, **streamed_kw)
+                    self.fed_round, self._chunk, chained=self._chained,
+                    **streamed_kw)
             else:
                 self._step = streamed_step(self.fed_round, **streamed_kw)
             self._evaluate = jax.jit(self.fed_round.evaluate)
         else:
-            if self._chunk > 1:
-                from functools import partial
-
-                self._step = jax.jit(
-                    partial(self.fed_round.multi_step, num_rounds=self._chunk)
-                )
-            else:
-                self._step = jax.jit(self.fed_round.step)
-            self._evaluate = jax.jit(self.fed_round.evaluate)
+            self._setup_dense_pipeline()
 
         self.timers = Timers()
         self._iteration = 0
         self._rounds_since_eval = 0
         self._last_eval: Dict = {}
+
+    def _setup_dense_pipeline(self) -> None:
+        """Single-chip dense path with the perf layer (blades_tpu/perf):
+        the round program is AOT-compiled through the process-wide
+        executable cache (identically-shaped sweep trials compile once),
+        the incoming :class:`RoundState` is DONATED into each dispatch
+        (the stacked client opt states — the largest tensors on this
+        path — are reused in place instead of copied), and with
+        ``prefetch`` on, the next round's per-client batches are staged
+        by a separately-dispatched sampling program while the current
+        round computes.  All three are bit-transparent: aggregates and
+        round metrics match the eager ``jax.jit(fr.step)`` path exactly
+        (tests/test_perf.py)."""
+        from functools import partial
+
+        from blades_tpu.perf import cached_jit
+
+        cfg = self.config
+        donate = (0,) if getattr(cfg, "donate_buffers", True) else ()
+        fp = self._program_fingerprint()
+        self._prefetcher = None
+        if self._chunk > 1 and self._chained:
+            step_fn = partial(self.fed_round.multi_step_chained,
+                              num_rounds=self._chunk)
+            key = ("step", "chained", self._chunk, fp)
+        elif self._chunk > 1:
+            step_fn = partial(self.fed_round.multi_step, num_rounds=self._chunk)
+            key = ("step", "multi", self._chunk, fp)
+        elif self._resolve_prefetch():
+            from blades_tpu.data.prefetch import BatchPrefetcher
+
+            sample = (cached_jit(self.fed_round.sample_round_batches,
+                                 key=("sample", fp))
+                      if fp else jax.jit(self.fed_round.sample_round_batches))
+            self._sample = lambda k: sample(*self._train_arrays, k)
+            self._prefetcher = BatchPrefetcher(self._sample)
+            if fp:
+                self._cache_wrappers = [sample]
+            step_fn = self.fed_round.step_prebatched
+            key = ("step", "prebatched", fp)
+        else:
+            step_fn = self.fed_round.step
+            key = ("step", "fused", fp)
+        if fp:
+            self._step = cached_jit(step_fn, key=key, donate_argnums=donate)
+            self._evaluate = cached_jit(self.fed_round.evaluate,
+                                        key=("evaluate", fp))
+            self._cache_wrappers = ([self._step, self._evaluate]
+                                    + self._cache_wrappers)
+        else:
+            # Un-fingerprintable config (callable model/config values):
+            # the executable cannot be safely shared across trials, but
+            # donation still applies per-trial.
+            self._step = jax.jit(step_fn, donate_argnums=donate)
+            self._evaluate = jax.jit(self.fed_round.evaluate)
+
+    def _resolve_prefetch(self) -> bool:
+        """``prefetch='auto'`` resolves to ON for the dense single-round
+        dispatch (the path with a per-round sampling stage to overlap)
+        on an accelerator backend; ``rounds_per_dispatch > 1`` samples
+        inside the scan, where there is nothing left to stage, and the
+        single-threaded CPU backend has no transfer/compute overlap to
+        win — there 'auto' skips the second program's compile.  ``True``
+        forces it anywhere (the bit-identity tests do)."""
+        want = getattr(self.config, "prefetch", "auto")
+        if want in (False, "off"):
+            return False
+        if self._chunk != 1:
+            return False
+        if want in (True, "on"):
+            return True
+        return jax.default_backend() != "cpu"
+
+    def _program_fingerprint(self) -> Optional[str]:
+        """Static-config fingerprint for the AOT executable cache
+        (:mod:`blades_tpu.perf.compile_cache`).
+
+        Must cover every value the traced round program bakes in as a
+        constant.  ``seed`` is excluded on purpose — it only steers data
+        values and PRNG key values, both runtime arguments — which is
+        exactly what lets a seed grid share one executable.  Dataset
+        objects contribute their name only (their arrays are arguments
+        too), EXCEPT FLTrust's root data, which the program closes over
+        and is therefore digested by value.  Returns ``None`` when the
+        config holds values a stable fingerprint cannot capture
+        (callables), disabling cross-trial sharing for that trial.
+        """
+        from blades_tpu.perf import fingerprint
+
+        def plain(v) -> bool:
+            # Recursive: a nested custom object (e.g. a callback INSTANCE
+            # in client_callbacks) would stringify to a memory-address
+            # repr — which a recycled allocation could collide on,
+            # silently serving another trial's executable.  Only plainly
+            # JSON-able values may enter the fingerprint.
+            if isinstance(v, (str, int, float, bool, type(None))):
+                return True
+            if isinstance(v, (list, tuple)):
+                return all(plain(x) for x in v)
+            if isinstance(v, dict):
+                return all(isinstance(k, str) and plain(x)
+                           for k, x in v.items())
+            return False
+
+        items: Dict = {"__class__": type(self).__name__,
+                       "__augment__": str(self.fed_round.task.spec.augment)}
+        for k, v in self.config.items():
+            if k == "seed":
+                continue
+            if k == "dataset" and not isinstance(v, (str, dict)):
+                v = f"<dataset:{getattr(v, 'name', type(v).__name__)}>"
+            if not plain(v):
+                return None
+            items[k] = v
+        td = self.fed_round.trusted_data
+        if td is not None:
+            import hashlib
+
+            h = hashlib.sha1()
+            for a in td:
+                h.update(np.asarray(a).tobytes())
+            items["__trusted_digest__"] = h.hexdigest()
+        return fingerprint(items)
 
     # Fallback dense-matrix budget when the device will not say how much
     # HBM it has: a dense f32 (n, d) update matrix past this strains one
@@ -349,66 +474,165 @@ class Fedavg:
     def train(self) -> Dict:
         """One training dispatch (= ``rounds_per_dispatch`` FL rounds, 1 by
         default) + periodic eval, returns the last round's result dict."""
-        round_key, self._key = jax.random.split(self._key)
+        return self.finalize_row(self._train_raw(fetch=True))
+
+    def train_raw(self) -> Dict:
+        """One training dispatch WITHOUT the host sync on round-scalar
+        metrics: the returned row carries its device metrics under
+        ``perf.async_metrics.DEVICE_METRICS_KEY`` and must be passed
+        through :meth:`finalize_row` (or ``perf.flush_rows``, which
+        batches the ``device_get`` across rows) before it is consumed.
+        The async sweep loop (``metrics_every > 1``) drives this."""
+        return self._train_raw(fetch=False)
+
+    def _train_raw(self, fetch: bool) -> Dict:
         with self.timers.time("training_step"):
-            self.state, raw_metrics = self._step(
-                self.state, *self._train_arrays, self.malicious, round_key
-            )
-            # Concrete fetches inside the timer: block_until_ready alone can
-            # return early through remote-execution tunnels.  "lane_" keys
-            # are per-lane forensics vectors ((n,), stacked to (rounds, n)
-            # under rounds_per_dispatch) — kept whole, last round reported.
-            metrics, lanes = {}, {}
-            for k, v in raw_metrics.items():
-                if k.startswith("lane_"):
-                    arr = jax.device_get(v)
-                    lanes[k[len("lane_"):]] = arr[-1] if arr.ndim > 1 else arr
-                else:
-                    metrics[k] = float(v[-1] if getattr(v, "ndim", 0) else v)
+            if self._chained:
+                # The window program advances the key chain itself, one
+                # split per scanned round — handing back the carry a
+                # sequential driver would hold at the same round.
+                self.state, self._key, raw_metrics = self._step(
+                    self.state, *self._train_arrays, self.malicious,
+                    self._key
+                )
+            elif self._prefetcher is not None:
+                round_key, self._key = jax.random.split(self._key)
+                # Staged last dispatch (or drawn now on the first); the
+                # NEXT round's batches are dispatched right behind this
+                # round's step, overlapping its compute.  The peeked key
+                # equals the round key the next train() will split off.
+                bx, by = self._prefetcher.take(self._iteration, round_key)
+                self.state, raw_metrics = self._step(
+                    self.state, bx, by, self.malicious, round_key
+                )
+                self._prefetcher.stage(self._iteration + self._chunk,
+                                       jax.random.split(self._key)[0])
+            else:
+                round_key, self._key = jax.random.split(self._key)
+                self.state, raw_metrics = self._step(
+                    self.state, *self._train_arrays, self.malicious, round_key
+                )
+            if fetch:
+                # Concrete fetches inside the timer: block_until_ready
+                # alone can return early through remote-execution tunnels.
+                raw_metrics = jax.device_get(raw_metrics)
         self._iteration += self._chunk
         self._rounds_since_eval += self._chunk
-        result = {
+        row = {
             "training_iteration": self._iteration,
-            "train_loss": metrics["train_loss"],
-            "agg_norm": metrics["agg_norm"],
-            "update_norm_mean": metrics["update_norm_mean"],
+            DEVICE_METRICS_KEY: raw_metrics,
             "timers": self.timers.summary(),
         }
-        if self.config.fault_config:  # chaos layer (blades_tpu/faults)
-            # Participation is per round; report the dispatch's LAST round
-            # (consistent with the scalar metrics above) plus the static
-            # fault seed so a chaos run's metrics stream is replayable.
-            for k in ("num_participating", "num_straggled", "num_dropped"):
-                result[k] = int(metrics[k])
-            result["fault_seed"] = int(self.fed_round.faults.seed)
-        if self.config.health_check or self.config.forensics:
-            # Reduce over the dispatch chunk, not just its last round: a
-            # lane that went non-finite mid-chunk must surface even if it
-            # recovered by the last round (sum of per-round unhealthy lane
-            # counts; both opt-in modes emit the same per-round metric).
-            result["num_unhealthy"] = int(jnp.sum(raw_metrics["num_unhealthy"]))
-        if self.config.health_check:  # failure-detection metrics (health.py)
-            # ok only if EVERY round in the chunk was ok.
-            result["round_ok"] = bool(jnp.all(raw_metrics["round_ok"]))
-        if self.config.forensics:  # defense forensics (obs subsystem)
-            for k in ("byz_precision", "byz_recall", "byz_fpr"):
-                result[k] = metrics[k]
-            result["num_flagged"] = int(metrics["num_flagged"])
-            result["lane_forensics"] = {
-                "benign_mask": [bool(b > 0.5) for b in lanes["benign_mask"]],
-                "healthy": [bool(h > 0.5) for h in lanes["healthy"]],
-                "scores": [float(s) for s in lanes["scores"]],
-            }
+        if self._cache_wrappers:
+            # Per-trial AOT compile-cache counters (obs schema fields):
+            # cumulative over this trial's dispatches, so the first row
+            # already says whether the round program was a hit or a miss.
+            row["compile_cache_hits"] = sum(
+                w.stats["hits"] for w in self._cache_wrappers)
+            row["compile_cache_misses"] = sum(
+                w.stats["misses"] for w in self._cache_wrappers)
         # Rounds-since-last-eval cadence: robust to rounds_per_dispatch not
         # dividing evaluation_interval (a modulo test would then never fire).
         if self.config.evaluation_interval and (
             self._rounds_since_eval >= self.config.evaluation_interval
         ):
             self._rounds_since_eval = 0
-            result.update(self.evaluate())
+            row.update(self.evaluate())
         elif self._last_eval:
-            result.update(self._last_eval)
-        return result
+            row.update(self._last_eval)
+        return row
+
+    def finalize_row(self, row: Dict) -> Dict:
+        """Convert a (possibly deferred) row's device metrics into the
+        host-scalar result dict ``train()`` has always returned.  "lane_"
+        keys are per-lane forensics vectors (``(n,)``, stacked to
+        ``(rounds, n)`` under ``rounds_per_dispatch``) — kept whole, last
+        round reported."""
+        raw = row.pop(DEVICE_METRICS_KEY, None)
+        if raw is None:
+            return row
+        raw = jax.device_get(raw)
+        self._fill_round_metrics(row, raw, idx=None)
+        return row
+
+    def _fill_round_metrics(self, row: Dict, raw: Dict, idx) -> None:
+        """Fill ``row`` with the host form of the fetched metrics dict.
+
+        ``idx=None``: the classic dispatch summary — scalars from the
+        chunk's LAST round, health counts reduced over the whole chunk (a
+        lane that went non-finite mid-chunk must surface even if it
+        recovered by the last round).  ``idx=r``: round ``r``'s values
+        from a stacked multi-round dispatch (the per-round rows of the
+        sweep's scan-window path)."""
+        metrics, lanes = {}, {}
+        for k, v in raw.items():
+            a = np.asarray(v)
+            if k.startswith("lane_"):
+                if a.ndim > 1:
+                    a = a[-1 if idx is None else idx]
+                lanes[k[len("lane_"):]] = a
+            elif a.ndim:
+                metrics[k] = float(a[-1 if idx is None else idx])
+            else:
+                metrics[k] = float(a)
+        row["train_loss"] = metrics["train_loss"]
+        row["agg_norm"] = metrics["agg_norm"]
+        row["update_norm_mean"] = metrics["update_norm_mean"]
+        if self.config.fault_config:  # chaos layer (blades_tpu/faults)
+            # Participation is per round; the dispatch summary reports the
+            # LAST round (consistent with the scalar metrics above) plus
+            # the static fault seed so a chaos run's stream is replayable.
+            for k in ("num_participating", "num_straggled", "num_dropped"):
+                row[k] = int(metrics[k])
+            row["fault_seed"] = int(self.fed_round.faults.seed)
+        if self.config.health_check or self.config.forensics:
+            u = np.asarray(raw["num_unhealthy"])
+            row["num_unhealthy"] = int(u.sum() if idx is None
+                                       else (u[idx] if u.ndim else u))
+        if self.config.health_check:  # failure-detection metrics (health.py)
+            ok = np.asarray(raw["round_ok"])
+            row["round_ok"] = bool(ok.all() if idx is None
+                                   else (ok[idx] if ok.ndim else ok))
+        if self.config.forensics:  # defense forensics (obs subsystem)
+            for k in ("byz_precision", "byz_recall", "byz_fpr"):
+                row[k] = metrics[k]
+            row["num_flagged"] = int(metrics["num_flagged"])
+            row["lane_forensics"] = {
+                "benign_mask": [bool(b > 0.5) for b in lanes["benign_mask"]],
+                "healthy": [bool(h > 0.5) for h in lanes["healthy"]],
+                "scores": [float(s) for s in lanes["scores"]],
+            }
+
+    def train_rows(self, per_round: bool = False) -> List[Dict]:
+        """One training dispatch, returned as result ROWS.
+
+        ``per_round=False`` (or a single-round dispatch): exactly
+        ``[self.train()]``.  ``per_round=True`` with
+        ``rounds_per_dispatch > 1`` expands the dispatch's stacked
+        metrics into one row per FL round — the sweep's scan-window
+        path: per-round granularity on disk, ONE program dispatch and
+        ONE batched ``device_get`` per window.  Rows before the window's
+        final round carry the previous evaluation (the same
+        repeat-last-eval convention as sequential rows); the final row
+        carries whatever :meth:`_train_raw` attached (fresh eval when
+        the cadence fired)."""
+        if not per_round or self._chunk == 1:
+            return [self.train()]
+        prev_eval = dict(self._last_eval)
+        start = self._iteration
+        tail = self._train_raw(fetch=True)
+        raw = tail.pop(DEVICE_METRICS_KEY)
+        shared = {k: tail[k] for k in ("timers", "compile_cache_hits",
+                                       "compile_cache_misses") if k in tail}
+        eval_keys = {k: tail[k] for k in ("test_loss", "test_acc",
+                                          "test_acc_top3") if k in tail}
+        rows = []
+        for r in range(self._chunk):
+            row = {"training_iteration": start + r + 1, **shared}
+            self._fill_round_metrics(row, raw, idx=r)
+            row.update(eval_keys if r == self._chunk - 1 else prev_eval)
+            rows.append(row)
+        return rows
 
     def evaluate(self) -> Dict:
         """Weighted per-client evaluation (ref: fedavg.py:247-279)."""
@@ -437,10 +661,15 @@ class Fedavg:
             return self._cost_analysis
         cost = None
         try:
-            lowered = self._step.lower(
-                self.state, *self._train_arrays, self.malicious,
-                jax.random.PRNGKey(0),
-            )
+            key = jax.random.PRNGKey(0)
+            if self._prefetcher is not None:
+                # The prebatched round program takes staged batches, not
+                # the resident shards — lower it with matching arguments.
+                bx, by = self._sample(key)
+                args = (self.state, bx, by, self.malicious, key)
+            else:
+                args = (self.state, *self._train_arrays, self.malicious, key)
+            lowered = self._step.lower(*args)
             ca = lowered.compile().cost_analysis()
             if isinstance(ca, (list, tuple)):  # older jax: one per device
                 ca = ca[0] if ca else None
@@ -531,6 +760,10 @@ class Fedavg:
 
             state, _ = shard_federation(self.mesh, state, ())
         self.state = state
+        if self._prefetcher is not None:
+            # The key chain rewound: any staged batches belong to the
+            # pre-restore timeline and must not feed a restored round.
+            self._prefetcher.invalidate()
 
     # -- misc ---------------------------------------------------------------
 

@@ -165,6 +165,30 @@ class FedRound:
 
     # -- the round ----------------------------------------------------------
 
+    def sample_round_batches(
+        self,
+        data_x: jax.Array,
+        data_y: jax.Array,
+        lengths: jax.Array,
+        key: jax.Array,
+    ) -> Tuple[jax.Array, jax.Array]:
+        """The batch-sampling half of :meth:`step`, split out so a
+        prefetcher (:mod:`blades_tpu.data.prefetch`) can stage round
+        ``r+1``'s batches while round ``r`` computes.  Consumes the SAME
+        ``k_sample`` fold of the round key as :meth:`step`, so::
+
+            step(state, x, y, ln, mal, key)
+            == step_prebatched(state, *sample_round_batches(x, y, ln, key),
+                               mal, key)
+
+        bit-for-bit (regression-tested per aggregator in
+        ``tests/test_perf.py``)."""
+        k_sample = jax.random.split(key, 5)[0]
+        return sample_client_batches(
+            k_sample, data_x, data_y, lengths, self.batch_size,
+            self.num_batches_per_round,
+        )
+
     def step(
         self,
         state: RoundState,
@@ -182,11 +206,26 @@ class FedRound:
             malicious: ``(n,)`` bool mask (the domain fault injection).
             key: round PRNG key.
         """
-        num_clients = data_x.shape[0]
+        bx, by = self.sample_round_batches(data_x, data_y, lengths, key)
+        return self.step_prebatched(state, bx, by, malicious, key)
+
+    def step_prebatched(
+        self,
+        state: RoundState,
+        bx: jax.Array,
+        by: jax.Array,
+        malicious: jax.Array,
+        key: jax.Array,
+    ) -> Tuple[RoundState, dict]:
+        """:meth:`step` with the per-client batches already drawn
+        (``(n, num_batches, batch, ...)``, from
+        :meth:`sample_round_batches` under the same round key).  The
+        round key is re-split identically and the sampling fold simply
+        goes unused, so the RNG stream — and therefore every output —
+        matches :meth:`step` exactly."""
+        num_clients = bx.shape[0]
         k_sample, k_train, k_adv, k_agg, k_dp = jax.random.split(key, 5)
-        bx, by = sample_client_batches(
-            k_sample, data_x, data_y, lengths, self.batch_size, self.num_batches_per_round
-        )
+        del k_sample  # consumed by sample_round_batches
         hooks = self._hooks()
         client_keys = jax.random.split(k_train, num_clients)
 
@@ -321,6 +360,39 @@ class FedRound:
 
         keys = jax.random.split(key, num_rounds)
         return jax.lax.scan(body, state, keys)
+
+    def multi_step_chained(
+        self,
+        state: RoundState,
+        data_x: jax.Array,
+        data_y: jax.Array,
+        lengths: jax.Array,
+        malicious: jax.Array,
+        key: jax.Array,
+        num_rounds: int,
+    ) -> Tuple[RoundState, jax.Array, dict]:
+        """:meth:`multi_step` with the DRIVER's key discipline: ``key`` is
+        the host loop's carry, and each scanned round consumes
+        ``round_key, carry = split(carry)`` — exactly what the sequential
+        driver does once per ``train()`` call.  Round ``r`` therefore
+        sees the identical PRNG key it would under round-per-dispatch
+        execution, making the windowed rounds bit-identical to eager
+        ones (which :meth:`multi_step`'s ``split(key, num_rounds)`` fan
+        is not).  Returns ``(state, advanced_carry, stacked_metrics)``;
+        the caller replaces its key chain with ``advanced_carry``, so a
+        checkpoint taken after a window matches a sequential checkpoint
+        at the same round, key and all."""
+
+        def body(carry, _):
+            st, ck = carry
+            rk, ck = jax.random.split(ck)
+            st, m = self.step(st, data_x, data_y, lengths, malicious, rk)
+            return (st, ck), m
+
+        (state, key), metrics = jax.lax.scan(
+            body, (state, key), None, length=num_rounds
+        )
+        return state, key, metrics
 
     def compute_trusted_update(self, global_params, key) -> Optional[jax.Array]:
         """The server's own local round on its clean root data (FLTrust's

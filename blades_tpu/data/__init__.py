@@ -18,4 +18,8 @@ from blades_tpu.data.datasets import (  # noqa: F401
     FLDataset,
     register_dataset,
 )
+from blades_tpu.data.prefetch import (  # noqa: F401
+    BatchPrefetcher,
+    prefetch_to_device,
+)
 from blades_tpu.data.sampler import sample_batch, sample_client_batches  # noqa: F401

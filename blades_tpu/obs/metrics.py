@@ -148,6 +148,11 @@ class StdoutSink(Sink):
                          ("num_unhealthy", "unhealthy={}")):
             if key in record:
                 parts.append(fmt.format(record[key]))
+        # Perf layer: show AOT-cache traffic once, on the first heartbeat
+        # — "cc=hit" is the at-a-glance sign a sweep trial skipped XLA.
+        if self._seen == 1 and "compile_cache_misses" in record:
+            parts.append("cc=" + ("hit" if record["compile_cache_misses"] == 0
+                                  else f"{record['compile_cache_misses']}miss"))
         print(" ".join(parts), flush=True)
 
 

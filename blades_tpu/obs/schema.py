@@ -59,6 +59,11 @@ ROUND_RECORD_FIELDS: Dict[str, Tuple[tuple, bool]] = {
     "num_straggled": ((int,), False),
     "num_dropped": ((int,), False),
     "fault_seed": ((int,), False),
+    # perf layer (blades_tpu/perf): AOT executable-cache traffic,
+    # cumulative per trial — a trial whose round program was served from
+    # the cache reports misses == 0 from its first row.
+    "compile_cache_hits": ((int,), False),
+    "compile_cache_misses": ((int,), False),
     # defense forensics (obs/forensics.py)
     "byz_precision": (_NUM, False),
     "byz_recall": (_NUM, False),

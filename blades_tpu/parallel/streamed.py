@@ -606,7 +606,7 @@ def streamed_step(
                 # relay), so the check is cached by array identity.
                 import numpy as np
 
-                mal_np = np.asarray(malicious)
+                mal_np = np.asarray(malicious)  # host-sync: ok — once per mask object, by design (see comment above)
                 if not (bool(mal_np[:skip_blocks * client_block].all())
                         and not bool(mal_np[malicious_prefix:].any())):
                     raise ValueError(
@@ -735,6 +735,7 @@ def streamed_step(
 def streamed_multi_step(
     fr: FedRound,
     num_rounds: int,
+    chained: bool = False,
     **kw,
 ) -> Callable:
     """``rounds_per_dispatch`` for the streamed path: chain ``num_rounds``
@@ -756,17 +757,32 @@ def streamed_multi_step(
     ``(num_rounds, ...)`` like ``multi_step``'s.  The caller's
     ``state.client_opt`` is donated (pass ``donate=False`` in ``kw`` to
     keep it).
+
+    ``chained=True`` switches to the DRIVER's key discipline (see
+    :meth:`~blades_tpu.core.round.FedRound.multi_step_chained`): ``key``
+    is the host carry, each round consumes ``split(carry)``, and the
+    callable returns ``(state, advanced_carry, metrics)`` — the sweep's
+    scan-window mode, bit-identical per round to round-per-dispatch
+    execution.
     """
     step = streamed_step(fr, **kw)
 
     def multi(state: RoundState, data_x, data_y, lengths, malicious, key):
-        keys = jax.random.split(key, num_rounds)
+        if chained:
+            round_keys = []
+            for _ in range(num_rounds):
+                rk, key = jax.random.split(key)
+                round_keys.append(rk)
+        else:
+            round_keys = jax.random.split(key, num_rounds)
         all_metrics = []
         for r in range(num_rounds):
             state, m = step(state, data_x, data_y, lengths, malicious,
-                            keys[r])
+                            round_keys[r])
             all_metrics.append(m)
         metrics = jax.tree.map(lambda *vs: jnp.stack(vs), *all_metrics)
+        if chained:
+            return state, key, metrics
         return state, metrics
 
     multi.step = step

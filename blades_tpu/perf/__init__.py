@@ -1,0 +1,29 @@
+"""Round-pipeline performance layer (host side).
+
+Three coupled pieces that make the *orchestration around* the jitted
+round as fast as the round itself (ByzFL arXiv:2505.24802 and
+ring-allreduce Byzantine FL arXiv:2501.17392 both locate the
+robust-FL throughput ceiling here, not in the defense kernels):
+
+- :mod:`blades_tpu.perf.compile_cache` — buffer donation + an
+  in-process AOT executable cache (``jit(...).lower().compile()`` keyed
+  on abstract shapes/dtypes + a static round-config fingerprint) shared
+  across sweep trials and lane groups, plus wiring for JAX's persistent
+  compilation cache so repeat sweeps skip XLA entirely.
+- :mod:`blades_tpu.perf.async_metrics` — batched ``device_get`` of
+  per-round scalar metrics every ``metrics_every`` rounds (flushed at
+  checkpoint / preemption / fault boundaries so the chaos layer's
+  replay guarantees hold).
+- :mod:`blades_tpu.data.prefetch` (sibling) — double-buffered
+  device staging of the next round's per-client batches.
+"""
+
+from blades_tpu.perf.async_metrics import flush_rows  # noqa: F401
+from blades_tpu.perf.compile_cache import (  # noqa: F401
+    CachedFunction,
+    cache_stats,
+    cached_jit,
+    clear_cache,
+    enable_persistent_compilation_cache,
+    fingerprint,
+)

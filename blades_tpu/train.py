@@ -34,6 +34,21 @@ def main(argv=None) -> int:
                         help="skip the per-trial XLA cost analysis (it "
                         "recompiles the training dispatch once — expensive "
                         "for ResNet-scale models on CPU)")
+    common.add_argument("--metrics-every", type=int, default=1, metavar="N",
+                        help="batch the per-round scalar-metric fetch: "
+                        "device_get every N rounds instead of blocking per "
+                        "round (flushed at checkpoint/preemption "
+                        "boundaries; see README Performance)")
+    common.add_argument("--scan-window", default="auto", metavar="W",
+                        help="run eligible trials as multi_step scan "
+                        "windows of up to W rounds per dispatch while "
+                        "keeping one result row per round; 'auto' "
+                        "(default) picks the largest safe window, 1 "
+                        "disables")
+    common.add_argument("--compile-cache", default=None, metavar="DIR",
+                        help="enable JAX's persistent compilation cache in "
+                        "DIR so repeat sweeps skip XLA entirely (also via "
+                        "$BLADES_TPU_COMPILE_CACHE_DIR)")
     common.add_argument("-v", "--verbose", action="count", default=1)
 
     p_file = sub.add_parser("file", parents=[common],
@@ -88,6 +103,8 @@ def main(argv=None) -> int:
     p_run.add_argument("--rounds", type=int, default=100)
 
     args = parser.parse_args(argv)
+    scan_window = (args.scan_window if args.scan_window == "auto"
+                   else int(args.scan_window))
 
     from blades_tpu.tune import load_experiments_from_file, run_experiments
 
@@ -115,6 +132,9 @@ def main(argv=None) -> int:
                 lanes=not args.no_lanes,
                 metrics_csv=args.metrics_csv,
                 cost_analysis=not args.no_cost_analysis,
+                metrics_every=args.metrics_every,
+                scan_window=scan_window,
+                compile_cache_dir=args.compile_cache,
             )
 
     else:
@@ -133,6 +153,9 @@ def main(argv=None) -> int:
                 verbose=args.verbose,
                 metrics_csv=args.metrics_csv,
                 cost_analysis=not args.no_cost_analysis,
+                metrics_every=args.metrics_every,
+                scan_window=scan_window,
+                compile_cache_dir=args.compile_cache,
             )
 
     # --trace wraps EITHER subcommand (the run subcommand used to silently

@@ -7,6 +7,7 @@ import copy
 import csv
 import itertools
 import json
+import os
 import time
 from pathlib import Path
 from typing import Any, Dict, List, Optional, Tuple
@@ -216,6 +217,44 @@ def _trial_name(base: str, idx: int, trial_cfg: Dict) -> str:
     return f"{base}_{idx:05d}"
 
 
+# ---------------------------------------------------------------------------
+# scan windows: multi_step dispatch with per-round rows (perf layer)
+# ---------------------------------------------------------------------------
+
+_SCAN_WINDOW_CAP = 8
+
+
+def _auto_scan_window(config, max_rounds: int, checkpoint_freq: int,
+                      cap: int = _SCAN_WINDOW_CAP) -> int:
+    """Largest dispatch window ``w`` (``<= cap``) whose windowed execution
+    is OBSERVABLY identical to round-per-dispatch: ``w`` must divide the
+    round budget (no overshoot past the stop criterion), the eval
+    interval (evaluations land on the same rounds, against the same
+    state), and the checkpoint frequency (checkpoints can only fire on
+    dispatch boundaries).  Trials where the user pinned
+    ``rounds_per_dispatch`` keep their setting; forensics trials stay
+    sequential (their per-lane bundles are reported per dispatch).
+    Returns 1 when no window qualifies."""
+    if int(getattr(config, "rounds_per_dispatch", 1) or 1) != 1:
+        return 1
+    if getattr(config, "forensics", False):
+        return 1
+    if getattr(config, "num_devices", None):
+        return 1
+    if getattr(config, "execution", "auto") not in ("auto", "dense"):
+        return 1
+    interval = int(getattr(config, "evaluation_interval", 0) or 0)
+    for w in range(min(cap, max_rounds), 1, -1):
+        if max_rounds % w:
+            continue
+        if interval and interval % w:
+            continue
+        if checkpoint_freq and checkpoint_freq % w:
+            continue
+        return w
+    return 1
+
+
 def _read_results(path: Path) -> List[Dict]:
     """Parse a trial's ``result.json`` line stream (tolerant of a torn
     final line from a killed run)."""
@@ -363,6 +402,7 @@ def _run_lane_group(
     verbose: int,
     metrics_csv: bool = False,
     strict_metrics: bool = True,
+    metrics_every: int = 1,
 ) -> Dict[int, Dict]:
     """Run one lane group as a vmapped program; write each member trial's
     ``result.json``/``params.json``/metrics streams exactly as the
@@ -400,9 +440,23 @@ def _run_lane_group(
         print(f"== lane group {exp_name}[{group[0]}..{group[-1]}]: "
               f"{len(group)} trials x {max_rounds} rounds as one program ==",
               flush=True)
+    from blades_tpu.perf import cache_stats, fingerprint
+
+    cache_before = cache_stats()
     t0 = time.perf_counter()
-    results = run_lanes(builder, overrides, max_rounds)
+    # program_key: the group's SHARED static config (the lane signature
+    # with the per-lane knobs already sentinel-ed out) — identical groups
+    # across experiments/sweeps reuse one compiled lane program.
+    results = run_lanes(builder, overrides, max_rounds,
+                        program_key=(spec_run.upper(), fingerprint(sig_cfg),
+                                     len(overrides)),
+                        metrics_every=metrics_every)
     wall = time.perf_counter() - t0
+    cache_after = cache_stats()
+    cache_delta = {
+        "hits": cache_after["hits"] - cache_before["hits"],
+        "misses": cache_after["misses"] - cache_before["misses"],
+    }
 
     out: Dict[int, Dict] = {}
     for lane, i in enumerate(group):
@@ -434,6 +488,7 @@ def _run_lane_group(
             if wall else None,
             "best_test_acc": best, "final": final, "dir": str(tdir),
             "lanes": len(group),
+            "compile_cache": cache_delta,
         }
     return out
 
@@ -457,8 +512,37 @@ def run_experiments(
     retry_backoff_base: float = 0.5,
     retry_backoff_cap: float = 30.0,
     preempt_after: Optional[int] = None,
+    scan_window="auto",
+    metrics_every: int = 1,
+    compile_cache_dir: Optional[str] = None,
 ) -> List[Dict]:
     """Run every trial of every experiment; returns summaries.
+
+    **Round-pipeline perf layer** (:mod:`blades_tpu.perf`):
+
+    - ``scan_window="auto"`` (default): fresh simple sweeps (no
+      resume / retries / preemption hook) run each eligible trial
+      through ``multi_step`` scan windows — one XLA dispatch and ONE
+      batched metric fetch per window of up to ``8`` rounds — while
+      still writing one result row per FL round.  The window is chosen
+      by :func:`_auto_scan_window` so evaluation rounds, checkpoint
+      rounds and the stop criterion are untouched; rows are bit-
+      identical to sequential execution.  Pass an int to cap the window
+      (``1`` disables), or keep user-pinned ``rounds_per_dispatch``
+      trials as-is (they keep their classic one-row-per-dispatch
+      cadence).
+    - ``metrics_every``: for trials that stay round-per-dispatch, defer
+      the per-round scalar fetch and ``device_get`` in batches of this
+      many rows (flushed before every checkpoint save and before the
+      preemption hook fires, so the chaos layer's no-gap replay
+      guarantee holds; rows pending at a crash are simply re-run from
+      the restored checkpoint).
+    - ``compile_cache_dir`` (or ``$BLADES_TPU_COMPILE_CACHE_DIR``):
+      enable JAX's persistent compilation cache so repeat sweeps skip
+      XLA entirely.  Independent of the always-on in-process AOT
+      executable cache, whose per-trial hit/miss deltas land in each
+      summary under ``compile_cache`` (and per round in the metrics
+      stream as ``compile_cache_hits``/``compile_cache_misses``).
 
     **Metrics pipeline** (obs subsystem): every trial also streams one
     schema-validated JSONL record per round to ``<trial>/metrics.jsonl``
@@ -533,9 +617,21 @@ def run_experiments(
     from blades_tpu.faults.host import (PreemptionHook, atomic_checkpoint,
                                         retry_backoff)
     from blades_tpu.obs import CsvSink, JsonlSink, MetricsLogger, StdoutSink
+    from blades_tpu.perf import (cache_stats,
+                                 enable_persistent_compilation_cache,
+                                 flush_rows)
     from blades_tpu.utils.timers import Timers
 
+    enable_persistent_compilation_cache(compile_cache_dir)
     preempt_hook = PreemptionHook(preempt_after) if preempt_after else None
+    # Scan windows change dispatch boundaries, which is only safe to do
+    # implicitly on a fresh straight-line sweep: resume/retries can land
+    # on a round the window stride would overshoot, and the preemption
+    # hook's kill window is defined against per-round dispatches.
+    windows_ok = (scan_window not in (1, None, False) and not resume
+                  and max_failures == 0 and preempt_after is None)
+    window_cap = (_SCAN_WINDOW_CAP if scan_window == "auto"
+                  else int(scan_window or 1))
 
     root = Path(storage_path).expanduser()
     summaries = []
@@ -558,6 +654,7 @@ def run_experiments(
                         spec["run"], trials, group, max_rounds, exp_name,
                         root, verbose, metrics_csv=metrics_csv,
                         strict_metrics=strict_metrics,
+                        metrics_every=metrics_every,
                     ))
                 except Exception as exc:
                     # LOUD fallback: a lane-group failure means the
@@ -610,6 +707,15 @@ def run_experiments(
                 continue
             algo_cls, config = get_algorithm_class(spec["run"], return_config=True)
             config.update_from_dict(trial_cfg)
+            scan_w = (_auto_scan_window(config, max_rounds, checkpoint_freq,
+                                        window_cap) if windows_ok else 1)
+            if scan_w > 1:
+                # Windowed dispatch with the driver's key discipline
+                # (chained_dispatch): rows stay bit-identical to
+                # round-per-dispatch execution, checkpoints included.
+                config.rounds_per_dispatch = scan_w
+                config.chained_dispatch = True
+            cache_before = cache_stats()
             algo = config.build()
             resumed_from = None
             if resume:
@@ -654,7 +760,37 @@ def run_experiments(
                     logger = MetricsLogger(
                         sinks, base={"experiment": exp_name, "trial": tname}
                     )
+                    # Deferred-fetch mode (perf layer): rows keep their
+                    # scalar metrics on device and are flushed through ONE
+                    # batched device_get every `metrics_every` rows — and
+                    # unconditionally before checkpoint saves and the
+                    # preemption hook, so every round a checkpoint covers
+                    # is on disk first (the no-gap replay guarantee).
+                    defer = (metrics_every > 1 and scan_w <= 1
+                             and hasattr(algo, "train_raw")
+                             and hasattr(algo, "finalize_row"))
+                    per_round_rows = scan_w > 1 and hasattr(algo, "train_rows")
+                    pending: List[Dict] = []
+                    last_row: Dict = {}
                     with open(tdir / "result.json", mode) as f:
+
+                        def emit(rows):
+                            nonlocal best_acc, last_row
+                            for result in rows:
+                                result["trial"] = tname
+                                row = _jsonable(result)
+                                f.write(json.dumps(row) + "\n")
+                                logger.log(row)
+                                best_acc = max(best_acc,
+                                               result.get("test_acc", 0.0))
+                                last_row = result
+
+                        def flush_pending():
+                            nonlocal pending
+                            if pending:
+                                emit(flush_rows(pending, algo.finalize_row))
+                                pending = []
+
                         # Stop on training_iteration (actual FL rounds), not
                         # train() calls — one call advances
                         # rounds_per_dispatch rounds.
@@ -663,28 +799,50 @@ def run_experiments(
                             # it from steady-state rounds so neither timing
                             # pollutes the other.
                             with timers.time("round" if compiled else "compile"):
-                                result = algo.train()
+                                if per_round_rows:
+                                    rows = algo.train_rows(per_round=True)
+                                elif defer:
+                                    rows = None
+                                    pending.append(algo.train_raw())
+                                else:
+                                    rows = [algo.train()]
                             compiled = True
-                            result["trial"] = tname
-                            row = _jsonable(result)
-                            f.write(json.dumps(row) + "\n")
-                            logger.log(row)
-                            best_acc = max(best_acc, result.get("test_acc", 0.0))
+                            if rows is not None:
+                                emit(rows)
+                            elif (len(pending) >= metrics_every
+                                  or algo.iteration >= max_rounds):
+                                flush_pending()
+                            checkpoint_due = bool(
+                                checkpoint_freq
+                                and algo.iteration % checkpoint_freq == 0)
+                            if preempt_hook is not None or checkpoint_due:
+                                flush_pending()
                             if preempt_hook is not None:
                                 # Fires BETWEEN the row write and the
                                 # checkpoint save — the widest window a
                                 # real preemption lands in, so restore
                                 # must come from an older checkpoint.
                                 preempt_hook.check(algo.iteration)
-                            if checkpoint_freq and algo.iteration % checkpoint_freq == 0:
+                            if checkpoint_due:
+                                # The no-gap contract ("every round a
+                                # checkpoint covers is on disk first")
+                                # needs the rows DURABLE, not just out of
+                                # the deferred buffer: the checkpoint
+                                # below is fsynced, so a kill right after
+                                # it must not find these rows still in
+                                # the userspace file buffer.
+                                f.flush()
+                                os.fsync(f.fileno())
                                 name = f"ckpt_{algo.iteration:06d}"
                                 with timers.time("checkpoint"):
                                     atomic_checkpoint(algo.save_checkpoint,
                                                       tdir / name)
                                 ckpt_scores[name] = float(
-                                    result.get(checkpoint_score_attr, algo.iteration)
+                                    last_row.get(checkpoint_score_attr,
+                                                 algo.iteration)
                                 )
                                 _prune_checkpoints(tdir, checkpoint_keep_num, ckpt_scores)
+                        flush_pending()
                     break
                 except KeyboardInterrupt:
                     raise
@@ -761,6 +919,18 @@ def run_experiments(
                 "dir": str(tdir),
                 "timers": phase_timers,
             }
+            cache_after = cache_stats()
+            cache_delta = {
+                "hits": cache_after["hits"] - cache_before["hits"],
+                "misses": cache_after["misses"] - cache_before["misses"],
+            }
+            if cache_delta["hits"] or cache_delta["misses"]:
+                # AOT executable cache traffic attributable to THIS trial:
+                # an identically-shaped sweep reports misses on its first
+                # trial only, hits everywhere else.
+                summary["compile_cache"] = cache_delta
+            if scan_w > 1:
+                summary["scan_window"] = scan_w
             if (cost_analysis and failed_error is None
                     and hasattr(algo, "cost_analysis")):
                 cost = algo.cost_analysis()

@@ -187,7 +187,13 @@ def test_spectral_disagreement_quantified_on_borderline_geometry():
     np.testing.assert_array_equal(exact, spectral)
 
 
-@pytest.mark.parametrize("linkage", ["single", "average"])
+# The single-linkage MST program's XLA compile at n=1000 costs >2 min on
+# this 2-core CPU box (the timed EXECUTION it pins is <2 s) — tier-2, the
+# same large-compile class as the 8-device shard_map suites.  The spectral
+# average-linkage variant compiles fast and keeps the scale bound in
+# tier-1.
+@pytest.mark.parametrize("linkage", [
+    pytest.param("single", marks=pytest.mark.slow), "average"])
 def test_clustering_scales_to_1000(linkage):
     """n=1000 clustering step must complete in ~1s (VERDICT r1 #8)."""
     import time

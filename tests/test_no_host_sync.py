@@ -1,0 +1,90 @@
+"""Hot-path host-sync lint (perf layer, tier-1).
+
+The round pipeline's throughput rests on the jitted-round modules never
+blocking the dispatch queue: every ``device_get`` / ``np.asarray`` /
+``block_until_ready`` inside them is a host↔device round trip that
+through a remote-execution relay costs more than the round itself, and
+such stalls creep back in silently (a debug fetch left behind, a
+"harmless" numpy conversion).  This lint greps the DEVICE-SIDE modules —
+the ones whose code runs inside (or builds) the jitted round — for
+host-sync calls.  The sanctioned flush points all live in HOST modules
+(``algorithms/fedavg.py`` finalize/flush, ``tune/sweep.py``'s batched
+emit, ``perf/async_metrics.py``), which are deliberately not scanned.
+
+A device-side line that must sync (e.g. the streamed path's
+once-per-mask-object promise validation) carries an explicit
+``# host-sync: ok — <why>`` pragma; anything else fails here.
+"""
+
+import re
+from pathlib import Path
+
+ROOT = Path(__file__).parent.parent / "blades_tpu"
+
+# Modules whose code runs inside (or traces into) the jitted round.
+DEVICE_SIDE = [
+    "core/round.py",
+    "core/server.py",
+    "core/task.py",
+    "core/health.py",
+    "core/callbacks.py",
+    "data/sampler.py",
+    "data/augment.py",
+    "adversaries/base.py",
+    "adversaries/update_attacks.py",
+    "adversaries/training_attacks.py",
+    "faults/injector.py",
+    "ops/aggregators.py",
+    "ops/clustering.py",
+    "ops/layout.py",
+    "ops/masked.py",
+    "ops/pallas_round.py",
+    "ops/pallas_select.py",
+    "parallel/streamed.py",
+    "parallel/streamed_geometry.py",
+    "parallel/sharded.py",
+    "parallel/dsharded.py",
+]
+
+# Host-sync calls that stall the dispatch pipeline.  The numpy patterns
+# use a lookbehind so jnp.asarray/jnp.array (device ops) don't match.
+HOST_SYNC = re.compile(
+    r"jax\.device_get\("
+    r"|\.block_until_ready\("
+    r"|jax\.block_until_ready\("
+    r"|(?<![\w.])np\.asarray\("
+    r"|(?<![\w.])np\.array\("
+)
+PRAGMA = "# host-sync: ok"
+
+
+def test_device_side_modules_have_no_host_sync():
+    offenders = []
+    for rel in DEVICE_SIDE:
+        path = ROOT / rel
+        assert path.exists(), f"lint list is stale: {path} is gone"
+        for lineno, line in enumerate(path.read_text().splitlines(), 1):
+            stripped = line.strip()
+            if stripped.startswith("#"):
+                continue
+            if HOST_SYNC.search(line) and PRAGMA not in line:
+                offenders.append(f"blades_tpu/{rel}:{lineno}: {stripped}")
+    assert not offenders, (
+        "host-sync call(s) in jitted-round modules (each one stalls the "
+        "dispatch pipeline every round; move the fetch to a sanctioned "
+        "flush point — fedavg finalize_row / sweep batched emit — or, if "
+        "it is genuinely setup-time/once-per-object, mark the line with "
+        "'# host-sync: ok — <why>'):\n  " + "\n  ".join(offenders)
+    )
+
+
+def test_pragmas_carry_a_reason():
+    """A bare pragma defeats the lint's audit trail — require the why."""
+    bad = []
+    for rel in DEVICE_SIDE:
+        for lineno, line in enumerate((ROOT / rel).read_text().splitlines(), 1):
+            if PRAGMA in line:
+                tail = line.split(PRAGMA, 1)[1].strip(" -—")
+                if len(tail) < 8:
+                    bad.append(f"blades_tpu/{rel}:{lineno}")
+    assert not bad, f"host-sync pragmas without a reason: {bad}"

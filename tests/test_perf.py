@@ -1,0 +1,419 @@
+"""Round-pipeline perf layer tests (blades_tpu/perf + data/prefetch):
+
+- compile-count regression: N identically-shaped sweep trials lower and
+  compile the round program exactly once (the AOT executable cache);
+- donation: the pre-step RoundState's buffers are invalidated after a
+  donated dispatch (and stay alive with ``donate_buffers=False``);
+- bit-identity: prefetch on/off, deferred metric fetches, and the
+  sweep's chained scan windows all reproduce the eager path exactly,
+  per aggregator.
+"""
+
+import json
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from blades_tpu.algorithms import FedavgConfig
+from blades_tpu.ops.aggregators import AGGREGATORS
+from blades_tpu.perf import cache_stats, clear_cache, fingerprint
+from blades_tpu.tune import run_experiments
+
+
+def tiny_config(**overrides):
+    cfg = (
+        FedavgConfig()
+        .data(dataset="mnist", num_clients=6, seed=3)
+        .training(global_model="mlp", server_lr=1.0, train_batch_size=8,
+                  aggregator={"type": "Mean"})
+        .client(lr=0.1)
+        .evaluation(evaluation_interval=0)
+    )
+    for k, v in overrides.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+def _params(algo):
+    return [np.asarray(p) for p in jax.tree.leaves(algo.state.server.params)]
+
+
+# ---------------------------------------------------------------------------
+# AOT compile cache
+# ---------------------------------------------------------------------------
+
+
+def _seed_sweep(tmp_path, seeds, **kw):
+    experiments = {
+        "cc": {
+            "run": "FEDAVG",
+            "stop": {"training_iteration": 4},
+            "config": {
+                "dataset_config": {"type": "mnist", "num_clients": 6,
+                                   "train_bs": 8,
+                                   "seed": {"grid_search": list(seeds)}},
+                "global_model": "mlp",
+                "evaluation_interval": 2,
+                "server_config": {"lr": 1.0},
+            },
+        }
+    }
+    return run_experiments(experiments, storage_path=str(tmp_path),
+                           verbose=0, lanes=False, **kw)
+
+
+def test_identically_shaped_trials_compile_once(tmp_path):
+    """The acceptance criterion: a sweep of >= 3 identically-shaped
+    trials compiles the round program exactly once; the other trials
+    are cache hits, surfaced both in the summaries and in the metrics
+    stream."""
+    clear_cache()
+    summaries = _seed_sweep(tmp_path, seeds=(1, 2, 3))
+    stats = cache_stats()
+    assert stats["by_role"]["step"]["misses"] == 1, stats
+    assert stats["by_role"]["step"]["hits"] >= 2, stats
+    # Per-trial summary deltas: first trial owns every miss.
+    assert summaries[0]["compile_cache"]["misses"] >= 1
+    for s in summaries[1:]:
+        assert s["compile_cache"]["misses"] == 0, s
+        assert s["compile_cache"]["hits"] >= 1, s
+    # The obs stream carries the counters (schema-registered fields).
+    first = json.loads(
+        (Path(summaries[1]["dir"]) / "metrics.jsonl").read_text()
+        .splitlines()[0])
+    assert first["compile_cache_misses"] == 0
+    assert first["compile_cache_hits"] >= 1
+
+
+@pytest.mark.slow
+def test_shape_change_recompiles(tmp_path):
+    """Different geometry must NOT share an executable."""
+    clear_cache()
+    _seed_sweep(tmp_path / "a", seeds=(1,))
+    misses_6 = cache_stats()["by_role"]["step"]["misses"]
+    experiments = {
+        "cc8": {
+            "run": "FEDAVG",
+            "stop": {"training_iteration": 2},
+            "config": {
+                "dataset_config": {"type": "mnist", "num_clients": 8,
+                                   "train_bs": 8},
+                "global_model": "mlp",
+                "evaluation_interval": 2,
+                "server_config": {"lr": 1.0},
+            },
+        }
+    }
+    run_experiments(experiments, storage_path=str(tmp_path / "b"),
+                    verbose=0, lanes=False)
+    assert cache_stats()["by_role"]["step"]["misses"] == misses_6 + 1
+
+
+def test_fingerprint_stability():
+    assert fingerprint({"a": 1, "b": [2, 3]}) == fingerprint({"b": [2, 3], "a": 1})
+    assert fingerprint({"a": 1}) != fingerprint({"a": 2})
+
+
+def test_fingerprint_excludes_seed_only():
+    """Two configs differing only in seed share a program fingerprint;
+    differing in a baked-in static (server lr) must not."""
+    a = tiny_config().build()
+    b = tiny_config(seed=99).build()
+    c = tiny_config(server_lr=0.5).build()
+    assert a._program_fingerprint() == b._program_fingerprint()
+    assert a._program_fingerprint() != c._program_fingerprint()
+
+
+# ---------------------------------------------------------------------------
+# buffer donation
+# ---------------------------------------------------------------------------
+
+
+def test_donated_step_invalidates_pre_step_state():
+    algo = tiny_config().build()
+    leaves = jax.tree.leaves(algo.state.server.params)
+    algo.train()
+    assert all(l.is_deleted() for l in leaves), (
+        "RoundState was not donated into the round dispatch"
+    )
+    # The CURRENT state is alive and usable (next round, checkpoints).
+    assert all(not l.is_deleted()
+               for l in jax.tree.leaves(algo.state.server.params))
+
+
+def test_donation_opt_out_keeps_state_alive():
+    algo = tiny_config(donate_buffers=False).build()
+    leaves = jax.tree.leaves(algo.state.server.params)
+    algo.train()
+    assert all(not l.is_deleted() for l in leaves)
+
+
+# ---------------------------------------------------------------------------
+# prefetch
+# ---------------------------------------------------------------------------
+
+
+def test_batch_prefetcher_contract():
+    from blades_tpu.data.prefetch import BatchPrefetcher
+
+    calls = []
+
+    def sample(key):
+        calls.append(int(key))
+        return ("batch", int(key))
+
+    pf = BatchPrefetcher(sample)
+    assert pf.take(0, 7) == ("batch", 7)        # cold: sync draw
+    pf.stage(1, 8)
+    assert pf.take(1, 8) == ("batch", 8)        # warm: staged, no redraw
+    assert calls == [7, 8]
+    pf.stage(2, 9)
+    assert pf.take(5, 11) == ("batch", 11)      # index mismatch: redraw
+    pf.stage(6, 12)
+    pf.invalidate()
+    assert pf.take(6, 12) == ("batch", 12)      # invalidated: redraw
+    assert calls == [7, 8, 9, 11, 12, 12]
+
+
+def test_prefetch_to_device_order_and_values():
+    from blades_tpu.data.prefetch import prefetch_to_device
+
+    items = [np.full((3,), i, np.float32) for i in range(5)]
+    out = list(prefetch_to_device(iter(items), size=2))
+    assert len(out) == 5
+    for i, a in enumerate(out):
+        assert isinstance(a, jax.Array)
+        np.testing.assert_array_equal(np.asarray(a), items[i])
+
+
+def test_prefetch_bit_identity_fedavg_driver():
+    """The full driver surface: 5 Fedavg rounds with prefetch forced on
+    (staged batches + prebatched program + donation + AOT cache) vs
+    prefetch off — rows and params bit-equal."""
+    def build(prefetch):
+        cfg = tiny_config(prefetch=prefetch)
+        cfg.update_from_dict({
+            "num_malicious_clients": 2,
+            "adversary_config": {"type": "ALIE"},
+            "server_config": {"aggregator": {"type": "Median"}},
+        })
+        return cfg.build()
+
+    on, off = build(True), build(False)
+    assert on._prefetcher is not None and off._prefetcher is None
+    rows_on = [on.train() for _ in range(5)]
+    rows_off = [off.train() for _ in range(5)]
+    for r_on, r_off in zip(rows_on, rows_off):
+        for k in ("train_loss", "agg_norm", "update_norm_mean"):
+            assert r_on[k] == r_off[k], (k, r_on[k], r_off[k])
+    for p_on, p_off in zip(_params(on), _params(off)):
+        np.testing.assert_array_equal(p_on, p_off)
+
+
+# Tier-1 runs the headline aggregators (the BASELINE.json workload slice
+# + the trusted-row special case); the rest of the registry runs the
+# identical check in the full suite (`pytest tests/`) — two separately
+# compiled programs per aggregator is the irreducible cost, and the
+# 870 s tier-1 budget on this 2-core box cannot absorb all ten.
+_T1_AGGREGATORS = ("Mean", "Median", "Trimmedmean", "FLTrust")
+
+
+@pytest.mark.parametrize("agg_name", [
+    a if a in _T1_AGGREGATORS else pytest.param(a, marks=pytest.mark.slow)
+    for a in sorted(AGGREGATORS)])
+def test_prefetch_bit_identity_per_aggregator(agg_name):
+    """5 rounds of prefetch-split execution (sample_round_batches +
+    step_prebatched, the prefetch-ON program pair) vs the fused step
+    (prefetch OFF): params and round metrics bit-equal.  FedRound-level
+    on a deliberately tiny task so the compiles stay cheap; the
+    driver-level staging/donation path is covered by
+    test_prefetch_bit_identity_fedavg_driver above."""
+    from blades_tpu.adversaries import get_adversary, make_malicious_mask
+    from blades_tpu.core import FedRound, Server, TaskSpec
+
+    n, f, rounds = 6, 2, 5
+    task = TaskSpec(model="mlp", input_shape=(8, 8, 1), num_classes=4,
+                    lr=0.1).build()
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(n, 12, 8, 8, 1)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 4, size=(n, 12)), jnp.int32)
+    ln = jnp.full((n,), 12, jnp.int32)
+    mal = make_malicious_mask(n, f)
+    adv = get_adversary({"type": "ALIE"}, num_clients=n, num_byzantine=f)
+
+    server = Server.from_config(aggregator=agg_name, num_byzantine=f, lr=0.5)
+    fr = FedRound(task=task, server=server, adversary=adv, batch_size=4,
+                  trusted_data=((x[0, :8], y[0, :8])
+                                if agg_name == "FLTrust" else None))
+    fused = jax.jit(fr.step)
+    sample = jax.jit(fr.sample_round_batches)
+    split = jax.jit(fr.step_prebatched)
+    s_f = s_s = fr.init(jax.random.PRNGKey(0), n)
+    key = jax.random.PRNGKey(5)
+    for r in range(rounds):
+        k = jax.random.fold_in(key, r)
+        s_f, m_f = fused(s_f, x, y, ln, mal, k)
+        bx, by = sample(x, y, ln, k)
+        s_s, m_s = split(s_s, bx, by, mal, k)
+        for mk in ("train_loss", "agg_norm", "update_norm_mean"):
+            assert float(m_f[mk]) == float(m_s[mk]), (agg_name, r, mk)
+    for a, b in zip(jax.tree.leaves(s_f.server.params),
+                    jax.tree.leaves(s_s.server.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=agg_name)
+
+
+# ---------------------------------------------------------------------------
+# chained scan windows + deferred metric fetches (sweep loop)
+# ---------------------------------------------------------------------------
+
+
+def test_multi_step_chained_matches_sequential_chain():
+    """The scanned key discipline reproduces the host driver's chain:
+    state AND the advanced carry match the sequential run bitwise."""
+    algo = tiny_config(prefetch=False).build()
+    fr, state0 = algo.fed_round, algo.state
+    arrays, mal = algo._train_arrays, algo.malicious
+    key0 = jax.random.PRNGKey(11)
+
+    seq_state, seq_key = state0, key0
+    step = jax.jit(fr.step)
+    for _ in range(4):
+        rk, seq_key = jax.random.split(seq_key)
+        seq_state, _ = step(seq_state, *arrays, mal, rk)
+
+    from functools import partial
+
+    win_state, win_key, metrics = jax.jit(
+        partial(fr.multi_step_chained, num_rounds=4)
+    )(state0, *arrays, mal, key0)
+    np.testing.assert_array_equal(np.asarray(seq_key), np.asarray(win_key))
+    for a, b in zip(jax.tree.leaves(seq_state.server.params),
+                    jax.tree.leaves(win_state.server.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert np.asarray(metrics["train_loss"]).shape == (4,)
+
+
+def _result_rows(summary):
+    rows = []
+    for ln in (Path(summary["dir"]) / "result.json").read_text().strip().splitlines():
+        r = json.loads(ln)
+        r.pop("timers", None)
+        r.pop("compile_cache_hits", None)
+        r.pop("compile_cache_misses", None)
+        rows.append(r)
+    return rows
+
+
+def _bi_experiments():
+    return {
+        "bi": {
+            "run": "FEDAVG",
+            "stop": {"training_iteration": 6},
+            "config": {
+                "dataset_config": {"type": "mnist", "num_clients": 6,
+                                   "train_bs": 8},
+                "global_model": "mlp",
+                "evaluation_interval": 3,
+                "num_malicious_clients": 2,
+                "adversary_config": {"type": "ALIE"},
+                "server_config": {"lr": 1.0,
+                                  "aggregator": {"type": "Median"}},
+            },
+        }
+    }
+
+
+@pytest.fixture(scope="module")
+def sequential_rows(tmp_path_factory):
+    """The eager round-per-dispatch baseline both identity tests compare
+    against (one shared run keeps tier-1 inside its wall-clock budget)."""
+    tmp = tmp_path_factory.mktemp("seq")
+    [seq] = run_experiments(_bi_experiments(), storage_path=str(tmp),
+                            verbose=0, lanes=False, scan_window=1)
+    return _result_rows(seq)
+
+
+def test_scan_window_rows_bit_identical_to_sequential(tmp_path,
+                                                      sequential_rows):
+    [win] = run_experiments(_bi_experiments(), storage_path=str(tmp_path),
+                            verbose=0, lanes=False, scan_window="auto")
+    assert win.get("scan_window", 1) > 1, "auto window did not engage"
+    win_rows = _result_rows(win)
+    assert len(sequential_rows) == len(win_rows) == 6  # one row per round
+    assert sequential_rows == win_rows
+
+
+def test_deferred_metric_rows_bit_identical(tmp_path, sequential_rows):
+    [dfr] = run_experiments(_bi_experiments(), storage_path=str(tmp_path),
+                            verbose=0, lanes=False, scan_window=1,
+                            metrics_every=4)
+    assert sequential_rows == _result_rows(dfr)
+
+
+def test_scan_window_respects_checkpoint_and_stop(tmp_path):
+    """Windows must divide eval/checkpoint cadence and the stop round —
+    checkpoints land on the same rounds as sequential execution."""
+    exps = _bi_experiments()
+    [s] = run_experiments(exps, storage_path=str(tmp_path), verbose=0,
+                          lanes=False, checkpoint_freq=3,
+                          scan_window="auto")
+    assert s["rounds"] == 6
+    tdir = Path(s["dir"])
+    assert (tdir / "ckpt_000003").exists() and (tdir / "ckpt_000006").exists()
+    from blades_tpu.tune.sweep import verify_result_rounds
+
+    assert verify_result_rounds(tdir / "result.json") == [1, 2, 3, 4, 5, 6]
+
+
+def test_auto_window_stays_off_for_pinned_dispatch(tmp_path):
+    """User-pinned rounds_per_dispatch keeps its classic one-row-per-
+    dispatch cadence (back-compat with the chunked driver)."""
+    exps = _bi_experiments()
+    exps["bi"]["config"]["rounds_per_dispatch"] = 3
+    [s] = run_experiments(exps, storage_path=str(tmp_path), verbose=0,
+                          lanes=False, scan_window="auto")
+    assert "scan_window" not in s
+    rows = _result_rows(s)
+    assert [r["training_iteration"] for r in rows] == [3, 6]
+
+
+@pytest.mark.slow
+def test_streamed_chained_dispatch_matches_streamed_sequential():
+    """chained_dispatch on the streamed path: windowed rounds consume
+    the exact keys the sequential driver would, so a chained 2-round
+    window reproduces two sequential streamed dispatches bitwise."""
+    def cfg(**kw):
+        c = tiny_config(prefetch=False)
+        c.update_from_dict({"update_dtype": "float32", "client_block": 3,
+                            "execution": "streamed", **kw})
+        return c
+
+    seq = cfg().build()
+    win = cfg(rounds_per_dispatch=2, chained_dispatch=True).build()
+    assert win._chained
+    for _ in range(4):
+        seq.train()
+    win.train()  # 2 windows of 2 rounds
+    win.train()
+    assert seq.iteration == win.iteration == 4
+    for a, b in zip(_params(seq), _params(win)):
+        np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# persistent compilation cache wiring
+# ---------------------------------------------------------------------------
+
+
+def test_persistent_cache_wiring(tmp_path, monkeypatch):
+    from blades_tpu.perf import enable_persistent_compilation_cache
+
+    target = tmp_path / "xla_cache"
+    assert enable_persistent_compilation_cache(str(target)) == str(target)
+    assert target.is_dir()
+    # Idempotent, and the env fallback resolves when no arg is given.
+    assert enable_persistent_compilation_cache(str(target)) == str(target)
