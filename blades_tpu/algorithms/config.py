@@ -117,6 +117,15 @@ class FedavgConfig:
         # "seed": 7}.  Seed defaults to the trial seed.  None disables —
         # the round program is then bit-identical to a faultless build.
         self.fault_config: Optional[Dict] = None
+        # comm subsystem (blades_tpu/comm): compressed-update codec spec,
+        # e.g. {"type": "quant", "bits": 8} or {"type": "topk",
+        # "topk_ratio": 0.01, "error_feedback": True}.  Encode->decode
+        # runs inside the jitted round before robust aggregation; per-
+        # round comm_bytes_up / codec_bits / compression-ratio metrics
+        # are stamped into the obs stream.  None disables — the round
+        # program is then bit-identical to a codec-free build (and
+        # {"type": "identity"} is a regression-tested no-op).
+        self.codec_config: Optional[Dict] = None
         # defense forensics (obs subsystem): per-lane aggregator telemetry
         # + Byzantine detection precision/recall/FPR emitted from inside
         # the jitted round; dense single-chip execution only
@@ -210,6 +219,13 @@ class FedavgConfig:
         """Defense forensics: per-lane aggregator diagnostics + Byzantine
         detection precision/recall/FPR per round (obs subsystem)."""
         return self._set(forensics=forensics)
+
+    def communication(self, *, codec=None):
+        """Compressed-update codec on the client->server uplink
+        (``codec=`` a dict for :class:`blades_tpu.comm.CodecConfig`,
+        e.g. ``{"type": "topk", "topk_ratio": 0.01}``); see the README
+        "Communication codecs" section for the interaction matrix."""
+        return self._set(codec_config=codec)
 
     # -- dict shim (ref: algorithm_config.py:253-293,360-379) ----------------
 
@@ -356,6 +372,25 @@ class FedavgConfig:
                     "lane axis — run the chaos pass without num_devices, "
                     "or disable faults"
                 )
+        if self.codec_config:
+            # Build the codec now so a bad spec fails at validate() time
+            # (CodecConfig.__post_init__ range-checks every knob).
+            self.get_codec()
+            if self.execution in ("streamed", "dsharded"):
+                raise ValueError(
+                    "update codecs (codec_config) are only formulated for "
+                    "the dense round — the streamed/d-sharded paths never "
+                    "materialise the full (n, d) matrix the encode->decode "
+                    "transform consumes; use execution='dense' (or 'auto' "
+                    "within the dense budget) or disable the codec"
+                )
+            if self.num_devices and self.num_devices > 1:
+                raise ValueError(
+                    "update codecs are single-chip for now: top-k selection "
+                    "and per-row scales under shard_map would shard the "
+                    "lane axis — run the compressed pass without "
+                    "num_devices, or disable the codec"
+                )
         if str(self.update_dtype) not in ("bfloat16", "float32"):
             raise ValueError(
                 f"update_dtype must be 'bfloat16' or 'float32', got "
@@ -446,6 +481,16 @@ class FedavgConfig:
         # (int, float) pairs) by FaultInjector.__post_init__ itself.
         return FaultInjector(**spec)
 
+    def get_codec(self):
+        """Build the comm subsystem's
+        :class:`~blades_tpu.comm.CodecConfig` from ``codec_config``
+        (None when disabled)."""
+        if not self.codec_config:
+            return None
+        from blades_tpu.comm import get_codec
+
+        return get_codec(self.codec_config)
+
     def get_client_callbacks(self) -> tuple:
         from blades_tpu.core.callbacks import ClippingCallback, get_callback
 
@@ -490,6 +535,7 @@ class FedavgConfig:
             health_check=self.health_check,
             forensics=self.forensics,
             faults=self.get_fault_injector(),
+            codec=self.get_codec(),
         )
 
     def build(self):

@@ -157,8 +157,10 @@ class Fedavg:
                 self._step = sharded_step(self.fed_round, self.mesh, donate=False)
             self._evaluate = sharded_evaluate(self.fed_round, self.mesh)
         elif self._use_streamed():
-            if cfg.forensics or cfg.fault_config:
-                what = "forensics" if cfg.forensics else "fault injection"
+            if cfg.forensics or cfg.fault_config or cfg.codec_config:
+                what = ("forensics" if cfg.forensics
+                        else "fault injection" if cfg.fault_config
+                        else "the update codec")
                 raise ValueError(
                     f"{what} needs the dense round but 'auto' execution "
                     "resolved to streaming (the dense (n, d) matrix would "
@@ -200,6 +202,11 @@ class Fedavg:
         self._iteration = 0
         self._rounds_since_eval = 0
         self._last_eval: Dict = {}
+        # Model width, pinned at setup: the codec's host-side byte
+        # accounting must not touch self.state later (whose buffers a
+        # donated dispatch deletes).
+        self._num_params = sum(
+            p.size for p in jax.tree.leaves(self.state.server.params))
 
     def _setup_dense_pipeline(self) -> None:
         """Single-chip dense path with the perf layer (blades_tpu/perf):
@@ -578,6 +585,18 @@ class Fedavg:
         row["train_loss"] = metrics["train_loss"]
         row["agg_norm"] = metrics["agg_norm"]
         row["update_norm_mean"] = metrics["update_norm_mean"]
+        codec = self.fed_round.codec  # comm subsystem (blades_tpu/comm)
+        if codec is not None:
+            # Static per-round byte accounting, stamped host-side so the
+            # device program carries no extra outputs.
+            row.update(codec.round_metrics(self.config.num_clients,
+                                           self._num_params))
+        if "elided_lanes" in metrics:
+            # Malicious-lane training elision engaged (streamed/d-sharded
+            # paths): surfaces the optimistic num_unhealthy basis — an
+            # elided lane never trains, so it can never trip the health
+            # counters (see parallel/dsharded.py caveats).
+            row["elided_lanes"] = int(metrics["elided_lanes"])
         if self.config.fault_config:  # chaos layer (blades_tpu/faults)
             # Participation is per round; the dispatch summary reports the
             # LAST round (consistent with the scalar metrics above) plus
@@ -741,7 +760,13 @@ class Fedavg:
                 # layer); remap along its client axis (axis 1).
                 stale=(None if getattr(state, "stale", None) is None
                        else state.stale[:, remap]),
+                # Error-feedback residual rows are per-client as well
+                # (comm subsystem); client axis is axis 0.
+                residual=(None if getattr(state, "residual", None) is None
+                          else state.residual[remap]),
             )
+        import dataclasses as _dc
+
         faults = self.fed_round.faults
         if (faults is not None and faults.needs_stale_buffer
                 and getattr(state, "stale", None) is None):
@@ -751,10 +776,17 @@ class Fedavg:
             from blades_tpu.utils.tree import ravel_fn
 
             _, _, d = ravel_fn(state.server.params)
-            state = type(state)(
-                server=state.server, client_opt=state.client_opt,
-                stale=faults.init_stale_buffer(n, d),
-            )
+            state = _dc.replace(state, stale=faults.init_stale_buffer(n, d))
+        codec = self.fed_round.codec
+        if (codec is not None and codec.needs_residual
+                and getattr(state, "residual", None) is None):
+            # Checkpoint from a run without error feedback resumed under
+            # a top-k+EF codec: start the residual cold (zeros), exactly
+            # like a fresh init.
+            from blades_tpu.utils.tree import ravel_fn
+
+            _, _, d = ravel_fn(state.server.params)
+            state = _dc.replace(state, residual=codec.init_residual(n, d))
         if self.mesh is not None:
             from blades_tpu.parallel import shard_federation
 

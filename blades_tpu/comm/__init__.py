@@ -1,0 +1,11 @@
+"""Communication subsystem: compressed update codecs under
+Byzantine-robust aggregation (see :mod:`blades_tpu.comm.codecs`)."""
+
+from blades_tpu.comm.codecs import (
+    CODEC_KEY_FOLD,
+    CODEC_NAMES,
+    CodecConfig,
+    get_codec,
+)
+
+__all__ = ["CODEC_KEY_FOLD", "CODEC_NAMES", "CodecConfig", "get_codec"]

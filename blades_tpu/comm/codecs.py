@@ -1,0 +1,223 @@
+"""Compressed update codecs: the client->server uplink as a first-class
+workload axis.
+
+The reference (and every path in this repo before the comm subsystem)
+exchanges full-precision pseudo-gradients for free; at the north-star
+scale — millions of clients — uplink bytes dominate the round, and the
+robustness literature treats pre-aggregation transforms as first-class
+precisely because compression and Byzantine defense interact
+non-trivially (ByzFL's pre-aggregation pipeline, arXiv:2505.24802;
+robust aggregation over bandwidth-constrained rings, arXiv:2501.17392).
+
+A codec is a frozen-dataclass static jit config exactly like the
+aggregators and the :mod:`blades_tpu.faults` injector: hashable round
+config whose encode->decode transform runs INSIDE the jitted round, on
+the stacked ``(n, d)`` update matrix, BEFORE fault injection and robust
+aggregation — so every aggregator sees the quantized geometry,
+adversaries forge post-codec (attacks exploit the compressed domain),
+and lane corruption composes with encoded payloads.
+
+Three codecs:
+
+- ``identity`` — bit-transparent wire simulation: the round program is
+  LITERALLY unchanged (the transform returns its input), regression-
+  tested bit-identical per aggregator, same discipline as
+  ``masked_call`` and the perf layer.
+- ``quant`` — stochastic uniform quantization to a symmetric int8/int4
+  grid with one f32 scale per client row (per-tensor scale).  The
+  rounding is PRNG-keyed (folded from the round key), which makes the
+  codec UNBIASED: ``E[decode(encode(u))] = u`` coordinate-wise
+  (statistically tested over keys in ``tests/test_comm.py``).
+- ``topk`` — magnitude top-k sparsification with client-side ERROR
+  FEEDBACK: each client adds its carried residual before selection and
+  keeps what it could not transmit, so the compression error is
+  re-injected instead of lost (the classic EF-SGD fixed point).  The
+  ``(n, d)`` residual rides :class:`~blades_tpu.core.round.RoundState`
+  (``None`` when the codec is off, so pytrees/checkpoints of
+  codec-free runs are unchanged — the ``faults/`` ring-buffer
+  pattern), and checkpoints carry it: a kill-and-resume replays the
+  compressed trajectory bit-identically.
+
+Decoded matrices stay f32 — quantized values are exactly representable
+on the ``scale * int`` grid and sparsified values are exact — so the
+codec simulates the wire without changing storage dtypes anywhere.
+Byte accounting (``payload_bytes``) is reconciled against the analytic
+ICI model in :mod:`blades_tpu.parallel.comm_model` (``uplink_bytes``),
+so throughput projections cover compressed rounds.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+CODEC_NAMES = ("identity", "quant", "topk")
+
+# fold_in() constant deriving the codec's rounding key from the round
+# key: a dedicated fold keeps every existing stream (sample/train/adv/
+# agg/dp) untouched, so a codec-free round is bit-identical to the
+# pre-comm program.
+CODEC_KEY_FOLD = 0xC0DE
+
+
+@dataclasses.dataclass(frozen=True)
+class CodecConfig:
+    """Static codec config; the transform is pure in ``(updates,
+    residual, key)``.
+
+    Attributes:
+        name: ``"identity" | "quant" | "topk"``.
+        bits: quantization bit-width (``quant``): 8 or 4, symmetric
+            signed grid with ``2**(bits-1) - 1`` positive levels.
+        topk_ratio: fraction of coordinates each client transmits
+            (``topk``): ``k = max(1, round(topk_ratio * d))``.
+        error_feedback: carry the untransmitted remainder as a
+            per-client residual added before the NEXT round's selection
+            (``topk`` only; ``quant`` is unbiased and needs none).
+    """
+
+    name: str = "identity"
+    bits: int = 8
+    topk_ratio: float = 0.01
+    error_feedback: bool = True
+
+    def __post_init__(self):
+        if self.name not in CODEC_NAMES:
+            raise ValueError(
+                f"codec name must be one of {CODEC_NAMES}, got {self.name!r}"
+            )
+        if self.name == "quant" and self.bits not in (4, 8):
+            raise ValueError(
+                f"quant bits must be 4 or 8 (int4/int8 wire grids), got "
+                f"{self.bits}"
+            )
+        if self.name == "topk" and not 0.0 < self.topk_ratio <= 1.0:
+            raise ValueError(
+                f"topk_ratio must be in (0, 1], got {self.topk_ratio}"
+            )
+
+    # -- static properties ---------------------------------------------------
+
+    @property
+    def needs_residual(self) -> bool:
+        """Whether :class:`~blades_tpu.core.round.RoundState` must carry
+        the ``(n, d)`` error-feedback residual."""
+        return self.name == "topk" and self.error_feedback
+
+    def topk_k(self, d: int) -> int:
+        """Coordinates transmitted per client row (``topk``)."""
+        return min(d, max(1, int(round(self.topk_ratio * d))))
+
+    @property
+    def wire_bits(self) -> int:
+        """Bits per transmitted coordinate VALUE on the wire (the
+        ``codec_bits`` metric): the quantization width, or 32 for the
+        f32 codecs (topk additionally ships an int32 index per value —
+        that cost lives in :meth:`payload_bytes`, not here)."""
+        return self.bits if self.name == "quant" else 32
+
+    def payload_bytes(self, n: int, d: int) -> int:
+        """Client->server uplink bytes for one round of ``n`` clients
+        with ``d``-coordinate updates — what the ``comm_bytes_up``
+        metric reports and :func:`blades_tpu.parallel.comm_model.
+        uplink_bytes` independently cross-checks.
+
+        identity: ``n * d * 4`` (dense f32 rows).
+        quant: ``n * (ceil(d * bits / 8) + 4)`` (packed grid + one f32
+        scale per row).
+        topk: ``n * k * 8`` (f32 value + int32 index per kept coord).
+        """
+        if self.name == "quant":
+            return n * ((d * self.bits + 7) // 8 + 4)
+        if self.name == "topk":
+            return n * self.topk_k(d) * 8
+        return n * d * 4
+
+    def round_metrics(self, n: int, d: int) -> dict:
+        """Host-side per-round comm telemetry (schema-registered fields
+        ``comm_bytes_up`` / ``codec_bits`` / ``comm_compression_ratio``).
+        Pure static config — stamped by the drivers, never computed on
+        device, so enabling the metrics cannot perturb the program."""
+        dense = n * d * 4
+        up = self.payload_bytes(n, d)
+        return {
+            "comm_bytes_up": int(up),
+            "codec_bits": int(self.wire_bits),
+            "comm_compression_ratio": round(dense / up, 4),
+        }
+
+    # -- state ---------------------------------------------------------------
+
+    def init_residual(self, num_clients: int, num_params: int):
+        """Zeros ``(n, d)`` error-feedback residual, or ``None`` when
+        this codec carries none."""
+        if not self.needs_residual:
+            return None
+        return jnp.zeros((num_clients, num_params), jnp.float32)
+
+    # -- the transform -------------------------------------------------------
+
+    def encode_decode(
+        self, updates: jax.Array, residual, key: jax.Array
+    ) -> Tuple[jax.Array, jax.Array]:
+        """One round of the simulated wire: ``(decoded, new_residual)``.
+
+        ``updates`` is the post-ghost-slice ``(n, d)`` matrix of what
+        clients computed; ``decoded`` is what the server receives.
+        ``residual`` is the carried EF state (``None`` unless
+        :attr:`needs_residual`).  ``key`` seeds the stochastic rounding
+        (``quant``); the deterministic codecs ignore it.
+        """
+        if self.name == "identity":
+            return updates, residual
+        if self.name == "quant":
+            return self._quantize(updates, key), residual
+        return self._topk(updates, residual)
+
+    def _quantize(self, u: jax.Array, key: jax.Array) -> jax.Array:
+        """Stochastic uniform quantization, per-row symmetric scale.
+
+        ``x = u / scale`` lands in ``[-s, s]``; stochastic rounding
+        takes ``floor(x) + Bernoulli(frac(x))``, whose expectation is
+        ``x`` — so ``E[q * scale] = u`` exactly (the unbiasedness the
+        statistical test pins down)."""
+        s = float(2 ** (self.bits - 1) - 1)
+        scale = jnp.max(jnp.abs(u), axis=1, keepdims=True) / s
+        x = u / jnp.where(scale > 0, scale, 1.0)
+        lo = jnp.floor(x)
+        q = lo + (jax.random.uniform(key, u.shape) < (x - lo))
+        return jnp.clip(q, -s, s) * scale
+
+    def _topk(
+        self, u: jax.Array, residual
+    ) -> Tuple[jax.Array, Optional[jax.Array]]:
+        """Magnitude top-k per row over ``u + residual``; the
+        untransmitted remainder becomes the new residual (EF)."""
+        n, d = u.shape
+        k = self.topk_k(d)
+        p = u + residual if residual is not None else u
+        _, idx = jax.lax.top_k(jnp.abs(p), k)          # (n, k)
+        rows = jnp.arange(n)[:, None]
+        sent = jnp.zeros_like(p).at[rows, idx].set(p[rows, idx])
+        return sent, (p - sent if residual is not None else residual)
+
+
+def get_codec(spec) -> Optional[CodecConfig]:
+    """Resolve a codec from a name, ``{"type": ..., **kwargs}`` dict
+    (house style, matching aggregators/adversaries; ``"name"`` accepted
+    too), an instance, or ``None``."""
+    if spec is None or isinstance(spec, CodecConfig):
+        return spec
+    if isinstance(spec, str):
+        spec = {"type": spec}
+    spec = dict(spec)
+    name = spec.pop("type", None) or spec.pop("name", None)
+    if name is None:
+        raise ValueError(
+            f"codec spec needs a 'type' (one of {CODEC_NAMES}): {spec!r}"
+        )
+    spec.pop("name", None)
+    return CodecConfig(name=name, **spec)

@@ -46,18 +46,26 @@ class RoundState:
     ``None`` unless a straggler process is configured, so the pytree of a
     fault-free run carries no extra leaves and existing checkpoints /
     sharding specs are unchanged.
+
+    ``residual`` is the comm subsystem's ``(n, d)`` error-feedback
+    residual (see :mod:`blades_tpu.comm.codecs`) — the same ``None``-
+    when-off discipline: only a top-k codec with error feedback adds the
+    leaf, so codec-free (and identity-codec) pytrees/checkpoints are
+    unchanged.
     """
 
     server: ServerState
     client_opt: Any  # pytree stacked over the client axis
     stale: Any = None
+    residual: Any = None
 
 
 jax.tree_util.register_pytree_node(
     RoundState,
-    # getattr: checkpoints pickled before the chaos layer existed restore
-    # as RoundState instances without a `stale` attribute.
-    lambda s: ((s.server, s.client_opt, getattr(s, "stale", None)), None),
+    # getattr: checkpoints pickled before the chaos/comm layers existed
+    # restore as RoundState instances without `stale`/`residual`.
+    lambda s: ((s.server, s.client_opt, getattr(s, "stale", None),
+                getattr(s, "residual", None)), None),
     lambda _, c: RoundState(*c),
 )
 
@@ -112,6 +120,14 @@ class FedRound:
     # branch on static config) — and a full-participation round under an
     # injector still takes the dense aggregation trace via lax.cond.
     faults: Any = None
+    # Comm subsystem (blades_tpu/comm): a CodecConfig whose encode->decode
+    # transform compresses the client updates inside the jitted round —
+    # BEFORE fault injection and robust aggregation, so every aggregator
+    # sees the quantized geometry, the adversary forges post-codec, and
+    # lane corruption composes with encoded payloads.  None keeps the
+    # program literally unchanged; the "identity" codec is a regression-
+    # tested bit-transparent no-op.
+    codec: Any = None
 
     # -- construction -------------------------------------------------------
 
@@ -121,7 +137,7 @@ class FedRound:
         client_opt = jax.tree.map(
             lambda x: jnp.broadcast_to(x, (num_clients,) + jnp.shape(x)), opt0
         )
-        stale = None
+        stale = residual = None
         if self.faults is not None and self.faults.needs_stale_buffer:
             from blades_tpu.utils.tree import ravel_fn
 
@@ -131,10 +147,20 @@ class FedRound:
             stale = self.faults.init_stale_buffer(
                 self.num_clients or num_clients, d
             )
+        if self.codec is not None and self.codec.needs_residual:
+            from blades_tpu.utils.tree import ravel_fn
+
+            _, _, d = ravel_fn(params)
+            # Error-feedback residual rows also match the post-ghost-
+            # slice matrix — the shape encode_decode() sees.
+            residual = self.codec.init_residual(
+                self.num_clients or num_clients, d
+            )
         return RoundState(
             server=self.server.init(params, num_clients),
             client_opt=client_opt,
             stale=stale,
+            residual=residual,
         )
 
     # -- hooks --------------------------------------------------------------
@@ -237,6 +263,22 @@ class FedRound:
         k = self.num_clients
         if k is not None and k < updates.shape[0]:
             updates, losses, malicious = updates[:k], losses[:k], malicious[:k]
+        # Comm subsystem (blades_tpu/comm): the simulated wire.  Encode ->
+        # decode runs at the point the updates "leave the clients" —
+        # before fault injection (a straggler's ring buffer then stores
+        # and replays POST-codec rows; lane corruption overwrites encoded
+        # payloads) and before forging (the adversary reads and exploits
+        # the compressed-domain geometry every defense will see).  The
+        # rounding key is a dedicated fold of the round key, so the
+        # existing sample/train/adv/agg/dp streams are untouched and a
+        # codec-free build stays bit-identical.
+        residual = getattr(state, "residual", None)
+        if self.codec is not None:
+            from blades_tpu.comm.codecs import CODEC_KEY_FOLD
+
+            updates, residual = self.codec.encode_decode(
+                updates, residual, jax.random.fold_in(key, CODEC_KEY_FOLD)
+            )
         # Chaos layer (blades_tpu/faults): dropout / stragglers / lane
         # corruption, realized deterministically from (fault seed, round).
         # Runs at the point the updates "arrive at the server" — before
@@ -334,7 +376,8 @@ class FedRound:
             metrics["lane_benign_mask"] = diag["benign_mask"].astype(jnp.float32)
             metrics["lane_scores"] = diag["scores"].astype(jnp.float32)
             metrics["lane_healthy"] = healthy_mask.astype(jnp.float32)
-        return RoundState(server=server, client_opt=client_opt, stale=stale), metrics
+        return RoundState(server=server, client_opt=client_opt, stale=stale,
+                          residual=residual), metrics
 
     def multi_step(
         self,

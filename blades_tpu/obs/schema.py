@@ -59,6 +59,19 @@ ROUND_RECORD_FIELDS: Dict[str, Tuple[tuple, bool]] = {
     "num_straggled": ((int,), False),
     "num_dropped": ((int,), False),
     "fault_seed": ((int,), False),
+    # comm subsystem (blades_tpu/comm): per-round uplink byte accounting
+    # for compressed-update codecs.  comm_bytes_up is the client->server
+    # wire payload (reconciled against parallel/comm_model.uplink_bytes),
+    # codec_bits the per-coordinate wire width, and the ratio is dense-
+    # f32 bytes over comm_bytes_up.
+    "comm_bytes_up": ((int,), False),
+    "codec_bits": ((int,), False),
+    "comm_compression_ratio": (_NUM, False),
+    # Malicious-lane training elision (streamed/d-sharded paths): lanes
+    # whose training was skipped this round.  Surfaced so the optimistic
+    # num_unhealthy basis — elided lanes can never trip health counters —
+    # is visible in telemetry.
+    "elided_lanes": ((int,), False),
     # perf layer (blades_tpu/perf): AOT executable-cache traffic,
     # cumulative per trial — a trial whose round program was served from
     # the cache reports misses == 0 from its first row.

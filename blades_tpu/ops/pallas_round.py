@@ -424,11 +424,16 @@ def _compact_kernel(x_ref, wb_ref, r_ref, o_ref, sq_ref, bad_ref, fr_ref, *,
         raise ValueError(f"unknown aggregator {akind!r}")
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("forged_mult", "forge", "agg", "sanitize", "num_real",
-                     "interpret", "radix_mxu", "stats_mxu"),
-)
+def _mxu_mode_from_env() -> Tuple[bool, bool]:
+    """``(radix_mxu, stats_mxu)`` from ``BLADES_TPU_MXU_FINISH``
+    ("", "counts", or "all"), read at CALL time by the un-jitted
+    :func:`fused_finish_compact` wrapper."""
+    import os
+
+    mode = os.environ.get("BLADES_TPU_MXU_FINISH", "")
+    return mode in ("counts", "all"), mode == "all"
+
+
 def fused_finish_compact(
     updates: jax.Array,
     forge_noise: Optional[jax.Array] = None,
@@ -443,6 +448,51 @@ def fused_finish_compact(
     stats_mxu: Optional[bool] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Forge + aggregate over a BENIGN-ONLY update matrix in one pass.
+
+    Thin un-jitted wrapper: ``radix_mxu``/``stats_mxu`` default to the
+    ``BLADES_TPU_MXU_FINISH`` env var ("", "counts", or "all"),
+    resolved HERE — outside the jit — on every call, then passed to the
+    jitted body as concrete static booleans.  Resolving inside the
+    traced body (the previous design) cached the first call's mode
+    under the ``None`` statics, so toggling the env after first call
+    silently kept the stale mode (ADVICE r5 #1).  Callers that jit
+    AROUND this wrapper (the streamed round's ``_finish_fused_compact``)
+    still pin the mode at their own trace time — that is their cache,
+    not this one.  See :func:`_fused_finish_compact_jit` for the full
+    contract.
+    """
+    if radix_mxu is None or stats_mxu is None:
+        env_radix, env_stats = _mxu_mode_from_env()
+        if radix_mxu is None:
+            radix_mxu = env_radix
+        if stats_mxu is None:
+            stats_mxu = env_stats
+    return _fused_finish_compact_jit(
+        updates, forge_noise, forged_mult=forged_mult, forge=forge, agg=agg,
+        sanitize=sanitize, num_real=num_real, interpret=interpret,
+        radix_mxu=bool(radix_mxu), stats_mxu=bool(stats_mxu),
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("forged_mult", "forge", "agg", "sanitize", "num_real",
+                     "interpret", "radix_mxu", "stats_mxu"),
+)
+def _fused_finish_compact_jit(
+    updates: jax.Array,
+    forge_noise: Optional[jax.Array] = None,
+    *,
+    forged_mult: int,
+    forge: tuple,
+    agg: tuple = ("median",),
+    sanitize: bool = False,
+    num_real: Optional[int] = None,
+    interpret: bool = False,
+    radix_mxu: bool = False,
+    stats_mxu: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """The jitted body of :func:`fused_finish_compact`.
 
     The malicious lanes' training was elided (parallel/streamed.py's
     ``malicious_prefix``), so the stored matrix holds just the ``nb``
@@ -465,18 +515,10 @@ def fused_finish_compact(
     ``ones @ indicator`` contraction instead of a VPU reduction —
     BIT-EXACT (counts are small integers, exact in f32).  ``stats_mxu``:
     also run the forged-row mean/var and row-norm reductions on the MXU
-    — same values up to f32 reassociation ulps.  Both default to the
-    ``BLADES_TPU_MXU_FINISH`` env var ("", "counts", or "all"), read at
-    TRACE time (jit caches on the resolved None, so set the env before
-    the first call of the process).
+    — same values up to f32 reassociation ulps.  Here both are concrete
+    static booleans; the public wrapper resolves the
+    ``BLADES_TPU_MXU_FINISH`` env default per call.
     """
-    import os
-
-    mode = os.environ.get("BLADES_TPU_MXU_FINISH", "")
-    if radix_mxu is None:
-        radix_mxu = mode in ("counts", "all")
-    if stats_mxu is None:
-        stats_mxu = mode == "all"
     nb, d = updates.shape
     if num_real is not None:
         if not (0 < num_real <= nb):

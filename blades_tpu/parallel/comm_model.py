@@ -167,24 +167,56 @@ def _adversary_volumes(adversary: Optional[str], n: int,
     raise ValueError(f"no comm model for adversary {adversary!r}")
 
 
+def uplink_bytes(n: int, d: int, codec=None, update_bytes: int = 4) -> int:
+    """Client->server uplink bytes per round — the analytic twin of the
+    dense round's ``comm_bytes_up`` metric, deliberately computed with
+    its OWN arithmetic (not by calling
+    :meth:`blades_tpu.comm.CodecConfig.payload_bytes`) so the metric and
+    the model cross-check each other in ``tests/test_comm.py``.
+
+    ``codec=None`` (or identity) is the uncompressed wire:
+    ``n * d * update_bytes`` — ``update_bytes`` defaults to 4 (dense f32
+    rows, matching ``CodecConfig.payload_bytes``); the d-sharded model
+    passes its storage dtype's width so identity and codec-free rounds
+    agree there too.  The quantization codec ships a packed
+    ``bits``-wide grid plus one f32 scale per client row; top-k ships
+    ``k`` (f32 value, int32 index) pairs per row.
+    """
+    if codec is None or codec.name == "identity":
+        return n * d * update_bytes
+    if codec.name == "quant":
+        return n * ((d * codec.bits + 7) // 8 + 4)
+    if codec.name == "topk":
+        return n * codec.topk_k(d) * 8
+    raise ValueError(f"no uplink model for codec {codec.name!r}")
+
+
 def dsharded_round_volumes(
     n: int, d: int, n_dev: int, *, update_bytes: int = 2,
     aggregator: str = "Median", adversary: Optional[str] = "ALIE",
-    health_check: bool = False, **agg_kw,
+    health_check: bool = False, codec=None, **agg_kw,
 ) -> List[CollectiveVolume]:
     """Every collective one d-sharded round issues, per chip.
 
     Mirrors :func:`blades_tpu.parallel.dsharded._build_dsharded_body`
     top to bottom; reconciled against the compiled HLO by
     ``tests/test_comm_model.py``.
+
+    ``codec``: a :class:`blades_tpu.comm.CodecConfig` models the axis
+    swap carrying the CODEC payload instead of dense rows — the analytic
+    what-if for compressed rounds on the mesh (the d-sharded runtime
+    itself is uncompressed today; the codec is formulated on the dense
+    round).  Every other collective is aggregator geometry over decoded
+    f32 values and is unchanged by compression.
     """
     d_pad = -(-d // n_dev) * n_dev
     n_local = -(-n // n_dev)
     f4 = 4
+    swap_payload = uplink_bytes(n_local, d_pad, codec,
+                                update_bytes=update_bytes)
     vols = [
         # The axis swap: (n_local, d_pad) rows leave as width shards.
-        CollectiveVolume("update_matrix_swap", "all_to_all",
-                         n_local * d_pad * update_bytes),
+        CollectiveVolume("update_matrix_swap", "all_to_all", swap_payload),
         # malicious mask (bool) + per-client losses (f32).
         CollectiveVolume("malicious_gather", "all_gather", n * 1),
         CollectiveVolume("losses_gather", "all_gather", n * f4),

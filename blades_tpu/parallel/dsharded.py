@@ -268,16 +268,39 @@ def _build_dsharded_body(fr: FedRound, mesh: Mesh,
     lanes and writes zero rows for the malicious ones, which the forge
     then overwrites post-swap.  Exact: bit-equal round output (DP rows
     are clipped/noised per-row, so zeroed dead rows stay dead;
-    tests/test_dsharded.py).  One telemetry caveat: ``num_unhealthy``
-    counts only TRAINED lanes — an elided malicious lane whose real
-    training would have produced non-finite values reads as healthy
-    (its zero row is finite), so health counts can differ from the
-    non-elided round even though server state is bit-equal.  Requires the STRIDED client layout —
+    tests/test_dsharded.py).  Requires the STRIDED client layout —
     every chip's local lanes are ``[f/n_dev malicious | benign]`` —
     produced by :func:`elision_client_order`; the step wrapper validates
     the caller's mask against that promise once per mask object.
     Ignored (trains everyone) when the adversary does not forge
     updates: a training-side attack's malicious lanes do real work.
+
+    Elision caveats (ADVICE r5) — exactness above is *within the strided
+    layout*; three things are observably different from other runs:
+
+    - **Telemetry basis**: ``num_unhealthy`` counts only TRAINED lanes —
+      an elided malicious lane whose real training would have produced
+      non-finite values reads as healthy (its zero row is finite), so
+      health counts can differ from the non-elided round even though
+      server state is bit-equal.  The ``elided_lanes`` round metric
+      (schema-registered) surfaces how many lanes that optimistic basis
+      excludes.
+    - **RNG pairing vs dense runs**: per-client sample/train keys derive
+      from LANE POSITION (``fold_in(axis_index)`` + per-lane splits),
+      and the elision layout PERMUTES which client sits in which lane
+      (:func:`elision_client_order`, applied by ``Fedavg._setup``).  An
+      elided run at seed ``s`` therefore pairs client ``i`` with a
+      different key stream than a natural-order dense run at the same
+      seed — statistically equivalent (both are valid iid assignments)
+      but NOT bitwise-comparable across layouts.  Elided vs non-elided
+      *on the same strided layout* (what tests assert) stays bit-equal.
+    - **Frozen optimizer state**: an elided malicious lane's
+      ``client_opt`` entry keeps its incoming value forever (the dead
+      training that would have evolved it is skipped), so CHECKPOINTS
+      diff against a non-elided run's even when server params are
+      bit-equal.  Unobservable in training unless an adversary stops
+      forging mid-run — which no registry attack does — but diff tools
+      comparing checkpoint files must expect it.
     """
     # Override check, not hasattr: the Adversary base class defines an
     # identity on_updates_ready, and a training-side attack (SignFlip)
@@ -409,6 +432,12 @@ def _build_dsharded_body(fr: FedRound, mesh: Mesh,
             "agg_norm": jnp.linalg.norm(agg),
             "round": server.round,
         }
+        if f_local:
+            # Telemetry for the optimistic num_unhealthy basis (see the
+            # elision caveats above): lanes whose training was skipped
+            # this round, federation-wide.  Only present when elision is
+            # engaged, keeping non-elided metrics pytrees unchanged.
+            metrics["elided_lanes"] = jnp.int32(f_local * n_dev)
         if fr.health_check:
             from blades_tpu.core.health import guard_server_state
 
@@ -434,6 +463,12 @@ def elision_client_order(n: int, f: int, n_dev: int):
     (uniform per-chip shapes; their rows are forged over regardless).
     Returns ``order`` such that ``array[order]`` lays clients out that
     way.
+
+    NOTE: applying this permutation changes which lane-position-derived
+    PRNG stream each client consumes, so a run on this layout is
+    statistically- but not bitwise-comparable to a natural-order run at
+    the same seed — see the elision caveats on
+    :func:`_build_dsharded_body`.
     """
     import numpy as np
 

@@ -716,6 +716,14 @@ def streamed_step(
                 state.server, updates_buf, malicious, jnp.concatenate(losses),
                 jnp.concatenate(norms), k_adv, k_dp,
             )
+        if skip_blocks:
+            # Elision telemetry (schema-registered): lanes whose training
+            # blocks were skipped this round — the lanes num_unhealthy can
+            # never count (an elided lane never trains, so it cannot trip
+            # the health detectors; see parallel/dsharded.py's elision
+            # caveats for the shared contract).  Only added when elision
+            # engages, so non-elided rounds' metrics are unchanged.
+            metrics["elided_lanes"] = jnp.int32(skip_blocks * client_block)
         return RoundState(server=server, client_opt=client_opt), metrics
 
     # Expose the jitted phases for profiling / inspection.  A round runs

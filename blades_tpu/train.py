@@ -99,7 +99,10 @@ def main(argv=None) -> int:
     p_run.add_argument("algo", help="FEDAVG or FEDAVG_DP")
     p_run.add_argument("--config-json", default="{}",
                        help='flat/nested config overrides as JSON, e.g. '
-                       '\'{"dataset_config": {"type": "mnist"}}\'')
+                       '\'{"dataset_config": {"type": "mnist"}}\' or a '
+                       'compressed-uplink run \'{"codec_config": '
+                       '{"type": "topk", "topk_ratio": 0.01}}\' '
+                       '(see README "Communication codecs")')
     p_run.add_argument("--rounds", type=int, default=100)
 
     args = parser.parse_args(argv)

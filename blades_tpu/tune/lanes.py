@@ -222,6 +222,16 @@ def run_lanes(
 
     states = jax.vmap(fr.init, in_axes=(0, None))(init_keys, base.num_clients)
 
+    # Comm subsystem: codec byte accounting is static shared config —
+    # stamped host-side into every lane's rows, exactly like the
+    # sequential driver (fedavg._fill_round_metrics).
+    comm_row = {}
+    if fr.codec is not None:
+        from blades_tpu.utils.tree import tree_size
+
+        d_model = tree_size(states.server.params) // L  # per-lane width
+        comm_row = fr.codec.round_metrics(base.num_clients, d_model)
+
     def lane_step(state, x, y, ln, mal, key, sc):
         return _apply_lane(fr, sc).step(state, x, y, ln, mal, key)
 
@@ -275,6 +285,7 @@ def run_lanes(
                     "update_norm_mean": float(metrics["update_norm_mean"][i]),
                     "seed": int(seeds[i]),
                 }
+                row.update(comm_row)
                 row.update({k: v for k, v in lane_overrides[i].items()
                             if k != "seed"})
                 row.update(last_eval[i])

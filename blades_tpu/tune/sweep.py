@@ -490,7 +490,19 @@ def _run_lane_group(
             "lanes": len(group),
             "compile_cache": cache_delta,
         }
+        comm = _comm_summary(rows[-1] if rows else {})
+        if comm:
+            out[i]["comm"] = comm
     return out
+
+
+def _comm_summary(row: Dict) -> Optional[Dict]:
+    """The comm subsystem's per-trial summary slice (codec byte
+    accounting is static per round, so the last row's values stand for
+    the whole trial)."""
+    comm = {k: row[k] for k in ("comm_bytes_up", "codec_bits",
+                                "comm_compression_ratio") if k in row}
+    return comm or None
 
 
 def run_experiments(
@@ -740,6 +752,7 @@ def run_experiments(
             failed_error = None
             timers = Timers()
             compiled = False
+            last_row: Dict = {}  # survives the attempt loop (comm summary)
             while True:
                 mode = "a" if (resumed_from or failures) else "w"
                 logger = None
@@ -771,7 +784,10 @@ def run_experiments(
                              and hasattr(algo, "finalize_row"))
                     per_round_rows = scan_w > 1 and hasattr(algo, "train_rows")
                     pending: List[Dict] = []
-                    last_row: Dict = {}
+                    # last_row deliberately NOT reset per attempt: a retry
+                    # that restores at the stop round emits no new rows,
+                    # and the checkpoint-score / comm summaries below must
+                    # still see the last row the trial produced.
                     with open(tdir / "result.json", mode) as f:
 
                         def emit(rows):
@@ -929,6 +945,11 @@ def run_experiments(
                 # an identically-shaped sweep reports misses on its first
                 # trial only, hits everywhere else.
                 summary["compile_cache"] = cache_delta
+            comm = _comm_summary(last_row)
+            if comm:
+                # Codec byte accounting (blades_tpu/comm), mirrored from
+                # the per-round metrics stream into the trial summary.
+                summary["comm"] = comm
             if scan_w > 1:
                 summary["scan_window"] = scan_w
             if (cost_analysis and failed_error is None

@@ -6,8 +6,17 @@ its collective inventory matches what XLA emits — so these tests lower
 :func:`dsharded_step` on the 8-device virtual mesh, scrape every
 collective op (kind + payload bytes) out of the compiled HLO, and
 reconcile the multiset against :func:`dsharded_round_volumes`.
+
+Coverage (ADVICE r5 #2): the HLO reconciliation runs over EVERY
+registered aggregator.  Tier-1 keeps the four headline configurations
+(Median/Multikrum under the bench adversaries + the health-check and
+fori-loop structural cases); the remaining aggregators carry
+``@pytest.mark.slow`` — each is another 8-virtual-device shard_map
+compile, minutes of wall clock this 2-core box's tier-1 budget cannot
+absorb — and run in the full suite (``pytest tests/``).
 """
 
+import dataclasses
 import re
 
 import jax
@@ -17,6 +26,7 @@ import pytest
 
 from blades_tpu.adversaries import get_adversary, make_malicious_mask
 from blades_tpu.core import FedRound, Server, TaskSpec
+from blades_tpu.ops.aggregators import AGGREGATORS
 from blades_tpu.parallel import make_mesh, shard_federation
 from blades_tpu.parallel.comm_model import (
     CollectiveVolume,
@@ -49,11 +59,25 @@ def _shape_bytes(m: re.Match) -> int:
 
 
 def hlo_collectives(txt: str):
-    """(kind, payload_bytes) for every collective in a compiled HLO.
+    """(kind, payload_bytes) for every PROGRAM-ISSUED collective in a
+    compiled HLO.
 
     The payload is read from the op's RESULT shape(s) — for all-gather
     that is the gathered size, for all-to-all the (tuple) total equals
     the per-chip payload, for all-reduce the reduced buffer.
+
+    One class of op is excluded: all-reduces whose ``op_name`` metadata
+    ends in ``/sort``.  Those are the CPU SPMD partitioner's chosen
+    IMPLEMENTATION of a *replicated* sort inside the shard_map body
+    (``argsort`` in the clustering aggregators,
+    ``jax.random.permutation``'s ``_shuffle`` in DnC): every chip holds
+    identical data, the partitioner splits the sort anyway and merges
+    with count all-reduces.  They are a backend lowering strategy for
+    redundantly-replicated work — not collectives the round's math
+    issues, and not something the one-axis TPU ring model should charge
+    wire time for (a replicated sort needs no exchange).  The explicit
+    program collectives all carry ``psum``/``all_gather``/``all_to_all``
+    op_names from the shard_map body and are counted in full.
     """
     out = []
     for line in txt.splitlines():
@@ -62,6 +86,10 @@ def hlo_collectives(txt: str):
                      r"reduce-scatter|collective-permute)\(", line)
         if not m:
             continue
+        op_name = re.search(r'op_name="([^"]*)"', line)
+        if (m.group(2) == "all-reduce" and op_name
+                and op_name.group(1).endswith("/sort")):
+            continue  # replicated-sort lowering artifact (see docstring)
         kind = {"all-to-all": "all_to_all", "all-gather": "all_gather",
                 "all-reduce": "psum", "reduce-scatter": "reduce_scatter",
                 "collective-permute": "permute"}[m.group(2)]
@@ -74,8 +102,29 @@ def make_fr(aggregator, adversary, **fr_kw):
     task = TaskSpec(model="mlp", lr=0.1, input_shape=(28, 28, 1)).build()
     server = Server.from_config(aggregator=aggregator, num_byzantine=F, lr=1.0)
     adv = get_adversary(adversary, num_clients=N, num_byzantine=F)
-    return FedRound(task=task, server=server, adversary=adv, batch_size=8,
-                    **fr_kw)
+    fr = FedRound(task=task, server=server, adversary=adv, batch_size=8,
+                  **fr_kw)
+    if aggregator == "FLTrust":
+        rng = np.random.default_rng(7)
+        tx = jnp.asarray(rng.normal(size=(32, 28, 28, 1)), jnp.float32)
+        ty = jnp.asarray(rng.integers(0, 10, size=(32,)), jnp.int32)
+        fr = dataclasses.replace(fr, trusted_data=(tx, ty))
+    return fr
+
+
+def model_kwargs_for(aggregator_obj, d: int) -> dict:
+    """The comm model's per-aggregator knobs, read off the INSTANCE the
+    compiled program actually closes over — so the reconciliation tests
+    cannot drift from aggregator defaults."""
+    kw = {}
+    if type(aggregator_obj).__name__ == "GeoMed":
+        kw["geomed_maxiter"] = aggregator_obj.maxiter
+    elif type(aggregator_obj).__name__ == "DnC":
+        kw["dnc_num_iters"] = aggregator_obj.num_iters
+        kw["dnc_sub_dim"] = min(aggregator_obj.sub_dim, d)
+    elif type(aggregator_obj).__name__ == "Centeredclipping":
+        kw["cc_n_iter"] = aggregator_obj.n_iter
+    return kw
 
 
 @pytest.fixture(scope="module")
@@ -96,11 +145,21 @@ def compiled_collectives(fr, fed_data):
     return hlo_collectives(txt)
 
 
-@pytest.mark.parametrize("aggregator,adversary,health", [
+# Tier-1: the four headline configurations.  The rest of the registry
+# runs the identical reconciliation in the full suite (slow lane): each
+# case is another 8-virtual-device shard_map compile.
+_T1_CASES = [
     ("Median", "ALIE", False),   # the bench headline round
     ("Median", "ALIE", True),
     ("Multikrum", "IPM", False),
     ("Median", "MinMax", False),  # grounds the 12-step bisection count
+]
+_T1_AGGS = {a for a, _, _ in _T1_CASES}
+
+
+@pytest.mark.parametrize("aggregator,adversary,health", _T1_CASES + [
+    pytest.param(a, "ALIE", False, marks=pytest.mark.slow)
+    for a in sorted(set(AGGREGATORS) - _T1_AGGS)
 ])
 def test_model_inventory_matches_compiled_hlo(fed_data, aggregator,
                                               adversary, health):
@@ -111,7 +170,8 @@ def test_model_inventory_matches_compiled_hlo(fed_data, aggregator,
 
     vols = dsharded_round_volumes(
         N, d, 8, update_bytes=4,  # f32 updates on the CPU test config
-        aggregator=aggregator, adversary=adversary, health_check=health)
+        aggregator=aggregator, adversary=adversary, health_check=health,
+        **model_kwargs_for(fr.server.aggregator, d))
 
     # Two structural caveats make per-op matching impossible:
     # - XLA's all-reduce combiner may MERGE independent psums into one

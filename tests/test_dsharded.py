@@ -262,6 +262,10 @@ def test_dsharded_elision_is_exact(data, aggregator, adversary):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     for k in ("train_loss", "agg_norm", "update_norm_mean"):
         np.testing.assert_array_equal(np.asarray(m_a[k]), np.asarray(m_b[k]))
+    # Elision telemetry (VERDICT item 6): floor(F/n_dev) lanes elided on
+    # each of the 8 chips; the non-elided round carries no such key.
+    assert int(m_b["elided_lanes"]) == (F // 8) * 8
+    assert "elided_lanes" not in m_a
 
 
 def test_dsharded_elision_ignored_for_training_attacks(data):

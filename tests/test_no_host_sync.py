@@ -34,6 +34,7 @@ DEVICE_SIDE = [
     "adversaries/update_attacks.py",
     "adversaries/training_attacks.py",
     "faults/injector.py",
+    "comm/codecs.py",
     "ops/aggregators.py",
     "ops/clustering.py",
     "ops/layout.py",

@@ -336,6 +336,40 @@ def test_compact_rejects_forgeless():
                              forge=None, interpret=True)
 
 
+def test_mxu_finish_env_resolved_per_call(monkeypatch):
+    """ADVICE r5 #1: BLADES_TPU_MXU_FINISH is resolved in the un-jitted
+    wrapper on EVERY call — toggling the env after the first call must
+    switch the mode (the old trace-time read cached the first call's
+    resolution under the None statics and silently kept it)."""
+    from blades_tpu.ops import pallas_round
+
+    seen = []
+
+    def spy(updates, noise=None, **kw):
+        seen.append((kw["radix_mxu"], kw["stats_mxu"]))
+        return "sentinel"
+
+    monkeypatch.setattr(pallas_round, "_fused_finish_compact_jit", spy)
+    x = jnp.zeros((8, 600))
+
+    monkeypatch.delenv("BLADES_TPU_MXU_FINISH", raising=False)
+    assert pallas_round.fused_finish_compact(
+        x, forged_mult=2, forge=("alie", 1.5)) == "sentinel"
+    monkeypatch.setenv("BLADES_TPU_MXU_FINISH", "counts")
+    pallas_round.fused_finish_compact(x, forged_mult=2, forge=("alie", 1.5))
+    monkeypatch.setenv("BLADES_TPU_MXU_FINISH", "all")
+    pallas_round.fused_finish_compact(x, forged_mult=2, forge=("alie", 1.5))
+    monkeypatch.setenv("BLADES_TPU_MXU_FINISH", "")
+    pallas_round.fused_finish_compact(x, forged_mult=2, forge=("alie", 1.5))
+    assert seen == [(False, False), (True, False), (True, True),
+                    (False, False)]
+    # Explicit arguments always beat the env.
+    monkeypatch.setenv("BLADES_TPU_MXU_FINISH", "all")
+    pallas_round.fused_finish_compact(x, forged_mult=2, forge=("alie", 1.5),
+                                      radix_mxu=False, stats_mxu=False)
+    assert seen[-1] == (False, False)
+
+
 def test_streamed_step_compact_branch_matches_chunked(monkeypatch):
     """Force the streamed round onto the benign-compacted fused finish
     (elided malicious prefix + virtual-multiplicity kernel, interpret
@@ -350,9 +384,12 @@ def test_streamed_step_compact_branch_matches_chunked(monkeypatch):
     monkeypatch.setattr(pallas_round, "should_use", lambda n, d: True)
     monkeypatch.setattr(pallas_select, "kernel_applicable",
                         lambda n, d: True)
+    # fused_finish_compact is an un-jitted wrapper (it resolves the
+    # BLADES_TPU_MXU_FINISH env per call, ADVICE r5 #1) — partial the
+    # wrapper itself to force interpret mode.
     monkeypatch.setattr(
         pallas_round, "fused_finish_compact",
-        functools.partial(pallas_round.fused_finish_compact.__wrapped__,
+        functools.partial(pallas_round.fused_finish_compact,
                           interpret=True),
     )
 
@@ -437,9 +474,12 @@ def test_streamed_step_compact_with_row_padding(monkeypatch):
     monkeypatch.setattr(pallas_round, "should_use", lambda n, d: True)
     monkeypatch.setattr(pallas_select, "kernel_applicable",
                         lambda n, d: True)
+    # fused_finish_compact is an un-jitted wrapper (it resolves the
+    # BLADES_TPU_MXU_FINISH env per call, ADVICE r5 #1) — partial the
+    # wrapper itself to force interpret mode.
     monkeypatch.setattr(
         pallas_round, "fused_finish_compact",
-        functools.partial(pallas_round.fused_finish_compact.__wrapped__,
+        functools.partial(pallas_round.fused_finish_compact,
                           interpret=True),
     )
 
