@@ -13,6 +13,11 @@ Two measured workloads, one JSON line:
    bf16 update matrix, client-block vmapped training, and the fused
    pallas finish (forge + exact Median in ONE HBM pass,
    ops/pallas_round.py).
+   (Plus, env-gated ``BLADES_BENCH_PACKED``: the 32-client dense CNN
+   protocol unpacked vs client lane-packed at ``pack_factor=2`` —
+   ``parallel/packed.py`` — emitting ``packed_lanes`` and BOTH MFU bases,
+   ``mfu_executed``/``mfu_all_lanes``, so the r3->r5 series stays
+   comparable; the same A/B rides the cpu_fallback path.)
 2. **ResNet-18 @ 768 clients** (the model BASELINE.json actually names):
    768 is the single-chip capacity limit under malicious-lane elision —
    the benign-compacted bf16 update matrix stores 576 rows = 12.9 GB
@@ -313,6 +318,111 @@ def bench_workload(model: str, num_clients: int, client_block: int,
     }
 
 
+def _measure_dense_cnn(pack: int | None, timed_rounds: int = 3) -> dict:
+    """The fixed 32-client dense CNN protocol (FedAvg + ALIE forge +
+    exact Median — the cpu_fallback config of round 3 onward), optionally
+    under client lane-packing (``parallel/packed.py``).
+
+    Reports BOTH MFU bases so the r3->r5 series stays comparable:
+    ``mfu_executed`` uses XLA's compiled FLOP count of the ACTUAL round
+    program that ran (the packed program's grouped kernels included),
+    ``mfu_all_lanes`` the analytic ``n x per-client`` basis every earlier
+    round used.  Packed runs additionally stamp ``pack_factor`` /
+    ``packed_lanes``, mirroring the round-metrics schema fields.
+    """
+    from blades_tpu.adversaries import get_adversary, make_malicious_mask
+    from blades_tpu.core import FedRound, Server, TaskSpec
+
+    num_clients, num_byzantine = 32, 8
+    task = TaskSpec(model="cnn", input_shape=(32, 32, 3), num_classes=10,
+                    lr=0.1).build()
+    server = Server.from_config(aggregator="Median", lr=0.5)
+    adv = get_adversary("ALIE", num_clients=num_clients,
+                        num_byzantine=num_byzantine)
+    packing = None
+    if pack:
+        from blades_tpu.parallel.packed import ClientPacking
+
+        packing = ClientPacking(pack=pack)
+    fr = FedRound(task=task, server=server, adversary=adv, batch_size=BATCH,
+                  num_batches_per_round=LOCAL_STEPS, packing=packing)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(num_clients, SHARD, 32, 32, 3)),
+                    jnp.float32)
+    y = jnp.asarray(rng.integers(0, 10, size=(num_clients, SHARD)), jnp.int32)
+    lengths = jnp.full((num_clients,), SHARD, jnp.int32)
+    mal = make_malicious_mask(num_clients, num_byzantine)
+    state = fr.init(jax.random.PRNGKey(0), num_clients)
+    step = jax.jit(fr.step, donate_argnums=(0,))
+
+    run, flops_round = step, None
+    try:
+        # ONE compile: the AOT executable both yields the executed-FLOP
+        # count and runs the timed loop — re-dispatching through the jit
+        # wrapper would not hit its cache (lower/compile bypasses it) and
+        # would pay a second full compile on the 2-core fallback box.
+        compiled = step.lower(state, x, y, lengths, mal,
+                              jax.random.PRNGKey(1)).compile()
+        run = compiled
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else None
+        if ca and ca.get("flops"):
+            flops_round = float(ca["flops"])
+    except Exception:
+        pass
+    flops_client = _flops_per_client_round(fr, state.server.params)
+    if not flops_client:
+        flops_client = BATCH * LOCAL_STEPS * 35e6  # analytic CNN fwd+bwd
+    flops_all_lanes = num_clients * flops_client
+
+    state, m = run(state, x, y, lengths, mal, jax.random.PRNGKey(1))
+    _ = float(m["train_loss"])  # compile + settle
+    t0 = time.perf_counter()
+    for r in range(timed_rounds):
+        state, metrics = run(state, x, y, lengths, mal,
+                             jax.random.fold_in(jax.random.PRNGKey(2), r))
+    final_loss = float(metrics["train_loss"])
+    assert final_loss == final_loss  # NaN guard
+    dt = time.perf_counter() - t0
+    rps = timed_rounds / dt
+    d = sum(p.size for p in jax.tree.leaves(state.server.params))
+    out = {
+        "rounds_per_sec": round(rps, 4),
+        "clients": num_clients, "byzantine": num_byzantine,
+        "model": "cnn", "params": d, "batch": BATCH,
+        "local_steps": LOCAL_STEPS, "timed_rounds": timed_rounds,
+        "aggregator": "Median", "adversary": "ALIE",
+        "path": "dense_packed" if pack else "dense",
+        "mfu_executed": (round(rps * flops_round / V5E_BF16_PEAK_FLOPS, 4)
+                         if flops_round else None),
+        "mfu_all_lanes": round(rps * flops_all_lanes / V5E_BF16_PEAK_FLOPS,
+                               4),
+        "flops_per_round_executed": flops_round,
+        "flops_per_round_all_lanes": flops_all_lanes,
+    }
+    if pack:
+        out["pack_factor"] = pack
+        out["packed_lanes"] = num_clients // pack
+    return out
+
+
+def _packed_cnn_block() -> dict:
+    """Satellite measurement: the 32-client CNN protocol unpacked vs
+    lane-packed (pack_factor=2 — two 64-channel clients per 128-lane
+    vreg), same rounds/keys, speedup reported.  Exact math (grouped
+    kernels are the per-client kernels reassociated), so the two runs
+    are the same experiment at two arithmetic intensities."""
+    unpacked = _measure_dense_cnn(pack=None)
+    packed = _measure_dense_cnn(pack=2)
+    speedup = None
+    if unpacked["rounds_per_sec"]:
+        speedup = round(packed["rounds_per_sec"]
+                        / unpacked["rounds_per_sec"], 3)
+    return {"unpacked": unpacked, "packed": packed,
+            "packed_speedup": speedup}
+
+
 def _cpu_fallback(probe_err: str) -> None:
     """The relay-dead-box path: measure a REDUCED configuration of the
     same pipeline (FedAvg + ALIE forge + exact Median, dense round, CPU
@@ -323,7 +433,8 @@ def _cpu_fallback(probe_err: str) -> None:
     (measured; the 1500 s watchdog holds with margin) — so cpu_fallback
     values are comparable ACROSS rounds with each other, never with TPU
     values; the ``backend`` tag and the probe failure in ``detail``
-    keep the two series separable."""
+    keep the two series separable.  ``BLADES_BENCH_PACKED=1`` (default)
+    additionally measures the lane-packed variant of the same config."""
     # Force the CPU backend BEFORE first backend init: sitecustomize sets
     # jax_platforms="axon,cpu", and a flapping axon plugin hangs instead
     # of failing fast — the exact pathology the probe subprocess exists
@@ -333,54 +444,28 @@ def _cpu_fallback(probe_err: str) -> None:
         jax.config.update("jax_platforms", "cpu")
     except Exception:
         pass
-    from blades_tpu.adversaries import get_adversary, make_malicious_mask
-    from blades_tpu.core import FedRound, Server, TaskSpec
-
-    num_clients, num_byzantine, timed_rounds = 32, 8, 3
-    task = TaskSpec(model="cnn", input_shape=(32, 32, 3), num_classes=10,
-                    lr=0.1).build()
-    server = Server.from_config(aggregator="Median", lr=0.5)
-    adv = get_adversary("ALIE", num_clients=num_clients,
-                        num_byzantine=num_byzantine)
-    fr = FedRound(task=task, server=server, adversary=adv, batch_size=BATCH,
-                  num_batches_per_round=LOCAL_STEPS)
-    rng = np.random.default_rng(0)
-    x = jnp.asarray(rng.normal(size=(num_clients, SHARD, 32, 32, 3)),
-                    jnp.float32)
-    y = jnp.asarray(rng.integers(0, 10, size=(num_clients, SHARD)), jnp.int32)
-    lengths = jnp.full((num_clients,), SHARD, jnp.int32)
-    mal = make_malicious_mask(num_clients, num_byzantine)
-    state = fr.init(jax.random.PRNGKey(0), num_clients)
-    step = jax.jit(fr.step, donate_argnums=(0,))
-
-    state, m = step(state, x, y, lengths, mal, jax.random.PRNGKey(1))
-    _ = float(m["train_loss"])  # compile + settle
-    t0 = time.perf_counter()
-    for r in range(timed_rounds):
-        state, metrics = step(state, x, y, lengths, mal,
-                              jax.random.fold_in(jax.random.PRNGKey(2), r))
-    final_loss = float(metrics["train_loss"])
-    assert final_loss == final_loss  # NaN guard
-    dt = time.perf_counter() - t0
-    rps = timed_rounds / dt
-    d = sum(p.size for p in jax.tree.leaves(state.server.params))
-    _emit({
+    unpacked = _measure_dense_cnn(pack=None)
+    out = {
         "metric": METRIC_NAME,
-        "value": round(rps, 4),
+        "value": unpacked["rounds_per_sec"],
         "unit": "rounds/s",
         "vs_baseline": None,
         "backend": "cpu_fallback",
         "detail": f"TPU probe failed ({probe_err[-400:]}); measured the "
                   "reduced cpu_fallback config instead — comparable only "
                   "with other cpu_fallback rounds",
-        "config": {
-            "clients": num_clients, "byzantine": num_byzantine,
-            "model": "cnn", "params": d, "batch": BATCH,
-            "local_steps": LOCAL_STEPS, "timed_rounds": timed_rounds,
-            "aggregator": "Median", "adversary": "ALIE",
-            "path": "dense_cpu",
-        },
-    })
+        "config": unpacked,
+    }
+    if os.environ.get("BLADES_BENCH_PACKED", "1") == "1":
+        try:
+            packed = _measure_dense_cnn(pack=2)
+            out["packed"] = packed
+            if unpacked["rounds_per_sec"]:
+                out["packed_speedup"] = round(
+                    packed["rounds_per_sec"] / unpacked["rounds_per_sec"], 3)
+        except Exception as e:
+            out["packed"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+    _emit(out)
 
 
 def main() -> None:
@@ -440,6 +525,15 @@ def main() -> None:
         except Exception as e:
             # The headline must survive a secondary-workload failure.
             out["resnet18"] = {"error": f"{type(e).__name__}: {e}"[:500]}
+
+    if os.environ.get("BLADES_BENCH_PACKED", "1") == "1":
+        try:
+            # Client lane-packing A/B on the 32-client dense CNN protocol
+            # (pack_factor=2): the first lever that raises arithmetic
+            # intensity per lane rather than amortizing dispatch/bytes.
+            out["packed_cnn"] = _packed_cnn_block()
+        except Exception as e:
+            out["packed_cnn"] = {"error": f"{type(e).__name__}: {e}"[:500]}
 
     _emit(out)
 

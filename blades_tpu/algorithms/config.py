@@ -108,6 +108,14 @@ class FedavgConfig:
         self.client_block: int = 50        # clients per streamed dispatch
         self.d_chunk: int = 1 << 17        # coords per streamed agg chunk
         self.update_dtype: str = "bfloat16"  # streamed matrix storage
+        # client lane-packing (parallel/packed.py): fold P clients into
+        # one grouped-kernel vmap lane on the dense path.  "off" | "auto"
+        # (pack_factor 2 iff the width/divisibility/hook heuristic passes,
+        # LOUD warning + unpacked fallback otherwise) | int P >= 2
+        # (forced; structural impossibilities raise).  Updates are
+        # unpacked to the dense (n, d) matrix before forging/codecs/
+        # faults/aggregation, and checkpoints stay layout-free.
+        self.client_packing: Any = "off"
         # failure detection / elastic recovery (core/health.py): zero
         # non-finite client lanes, skip non-finite server updates
         self.health_check: bool = False
@@ -135,6 +143,11 @@ class FedavgConfig:
         # resources
         self.num_devices: Optional[int] = None
         self._frozen = False
+        # Packing decision from the last get_fed_round() resolution
+        # (requested/pack_factor/packed_lanes/fallback) — surfaced in
+        # sweep trial summaries so operators can tell packed from
+        # unpacked runs without reading logs.
+        self._packing_decision = None
         # Names of fields whose values were INFERRED by validate() rather
         # than set by the user — retargeting the dataset resets them so a
         # copy()-then-rebuild re-infers instead of keeping stale values
@@ -202,11 +215,13 @@ class FedavgConfig:
                          evaluation_num_samples=num_samples)
 
     def resources(self, *, num_devices=None, execution=None, client_block=None,
-                  d_chunk=None, update_dtype=None, compute_dtype=None):
+                  d_chunk=None, update_dtype=None, compute_dtype=None,
+                  client_packing=None):
         return self._set(num_devices=num_devices, execution=execution,
                          client_block=client_block, d_chunk=d_chunk,
                          update_dtype=update_dtype,
-                         compute_dtype=compute_dtype)
+                         compute_dtype=compute_dtype,
+                         client_packing=client_packing)
 
     def fault_tolerance(self, *, health_check=None, faults=None):
         """In-round failure detection / elastic recovery (core/health.py)
@@ -391,6 +406,37 @@ class FedavgConfig:
                     "lane axis — run the compressed pass without "
                     "num_devices, or disable the codec"
                 )
+        if self.client_packing not in ("off", "auto", None):
+            # Forced int P: structural impossibilities fail at validate()
+            # time, the same fail-fast discipline as faults/codecs.  The
+            # full model-aware resolution (width heuristic, hook gates)
+            # runs in get_fed_round() via resolve_client_packing.
+            try:
+                p = int(self.client_packing)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    "client_packing must be 'off', 'auto' or an int >= 2, "
+                    f"got {self.client_packing!r}"
+                )
+            if p < 2:
+                raise ValueError(
+                    f"client_packing int must be >= 2, got {p}"
+                )
+            if self.num_clients % p:
+                raise ValueError(
+                    f"client_packing={p} does not divide num_clients="
+                    f"{self.num_clients}"
+                )
+            if self.num_devices and self.num_devices > 1:
+                raise ValueError(
+                    "client_packing is single-chip (no mesh formulation); "
+                    "run without num_devices or drop the packing"
+                )
+            if self.execution in ("streamed", "dsharded"):
+                raise ValueError(
+                    "client_packing needs the dense round; execution="
+                    f"{self.execution!r} never runs the packed local round"
+                )
         if str(self.update_dtype) not in ("bfloat16", "float32"):
             raise ValueError(
                 f"update_dtype must be 'bfloat16' or 'float32', got "
@@ -520,7 +566,7 @@ class FedavgConfig:
         return _dc.replace(fed_round, task=task)
 
     def get_fed_round(self) -> FedRound:
-        return FedRound(
+        fr = FedRound(
             task=self.get_task_spec().build(),
             server=self.get_server(),
             adversary=self.get_adversary(),
@@ -537,6 +583,18 @@ class FedavgConfig:
             faults=self.get_fault_injector(),
             codec=self.get_codec(),
         )
+        # Client lane-packing: resolve "auto"/forced requests against the
+        # built model (width heuristic, hook gates) — LOUD fallback under
+        # "auto", hard error for an impossible forced P.  The decision is
+        # cached for sweep summaries / laned rows (private attr: frozen
+        # configs only guard the public fluent setters).
+        from blades_tpu.parallel.packed import resolve_client_packing
+
+        fr, self._packing_decision = resolve_client_packing(
+            fr, self.client_packing, num_clients=self.num_clients,
+            num_devices=self.num_devices, execution=self.execution,
+        )
+        return fr
 
     def build(self):
         """(ref: algorithm_config.py:222-251)"""

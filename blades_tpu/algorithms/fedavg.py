@@ -19,6 +19,8 @@ from __future__ import annotations
 
 import pickle
 import time
+import warnings
+from dataclasses import replace as _dc_replace
 from pathlib import Path
 from typing import Dict, List, Optional
 
@@ -157,10 +159,29 @@ class Fedavg:
                 self._step = sharded_step(self.fed_round, self.mesh, donate=False)
             self._evaluate = sharded_evaluate(self.fed_round, self.mesh)
         elif self._use_streamed():
-            if cfg.forensics or cfg.fault_config or cfg.codec_config:
+            if (self.fed_round.packing is not None
+                    and cfg.client_packing == "auto"):
+                # resolve_client_packing can only veto EXPLICIT streamed/
+                # dsharded requests; when execution='auto' resolves to
+                # streaming here (HBM-driven), the advisory request keeps
+                # its loud-fallback contract instead of hard-failing.
+                reason = ("'auto' execution resolved to streaming at "
+                          f"num_clients={cfg.num_clients} (dense (n, d) "
+                          "matrix would strain HBM); lane packing needs "
+                          "the dense round")
+                warnings.warn(
+                    f"client_packing='auto' falling back to unpacked "
+                    f"execution: {reason}", RuntimeWarning, stacklevel=2)
+                self.fed_round = _dc_replace(self.fed_round, packing=None)
+                cfg._packing_decision = {
+                    "requested": "auto", "pack_factor": 1,
+                    "packed_lanes": cfg.num_clients, "fallback": reason}
+            if (cfg.forensics or cfg.fault_config or cfg.codec_config
+                    or self.fed_round.packing is not None):
                 what = ("forensics" if cfg.forensics
                         else "fault injection" if cfg.fault_config
-                        else "the update codec")
+                        else "the update codec" if cfg.codec_config
+                        else "client lane-packing")
                 raise ValueError(
                     f"{what} needs the dense round but 'auto' execution "
                     "resolved to streaming (the dense (n, d) matrix would "
@@ -478,6 +499,14 @@ class Fedavg:
     def iteration(self) -> int:
         return self._iteration
 
+    @property
+    def packing_summary(self) -> Optional[Dict]:
+        """The lane-packing decision get_fed_round() resolved for this
+        trial (requested/pack_factor/packed_lanes/fallback reason), or
+        None when packing was never requested — the sweep mirrors it
+        into trial summaries."""
+        return getattr(self.config, "_packing_decision", None)
+
     def train(self) -> Dict:
         """One training dispatch (= ``rounds_per_dispatch`` FL rounds, 1 by
         default) + periodic eval, returns the last round's result dict."""
@@ -591,6 +620,14 @@ class Fedavg:
             # device program carries no extra outputs.
             row.update(codec.round_metrics(self.config.num_clients,
                                            self._num_params))
+        packing = getattr(self.fed_round, "packing", None)
+        if packing is not None:
+            # Lane-packing provenance (parallel/packed.py): static per
+            # round, stamped host-side like the codec accounting so
+            # operators can tell packed from unpacked rows.
+            row["pack_factor"] = int(packing.pack)
+            row["packed_lanes"] = int(self.config.num_clients
+                                      // packing.pack)
         if "elided_lanes" in metrics:
             # Malicious-lane training elision engaged (streamed/d-sharded
             # paths): surfaces the optimistic num_unhealthy basis — an
@@ -720,6 +757,15 @@ class Fedavg:
             # client j's data.
             "client_order": (None if self._client_order is None
                              else list(map(int, self._client_order))),
+            # Lane-packing provenance.  RoundState stays in the canonical
+            # UNPACKED layout on every path (pack/unpack wrap only the
+            # local round), so unlike client_order there is nothing to
+            # remap on resume — any pack_factor restores any other; the
+            # value is recorded so a checkpoint's execution mode is
+            # auditable.
+            "pack_factor": (int(self.fed_round.packing.pack)
+                            if getattr(self.fed_round, "packing", None)
+                            is not None else 1),
             "config_dict": {k: v for k, v in self.config.items()
                             if not callable(v)},
         }

@@ -128,6 +128,16 @@ class FedRound:
     # program literally unchanged; the "identity" codec is a regression-
     # tested bit-transparent no-op.
     codec: Any = None
+    # Client lane-packing (blades_tpu/parallel/packed.py): a
+    # ClientPacking(pack=P) spec folds P clients into one grouped-kernel
+    # vmap lane for the LOCAL round only — updates are unpacked back to
+    # the dense (n, d) matrix before codecs, faults, DP, forging and
+    # aggregation, so everything downstream (and RoundState itself, which
+    # stays in canonical unpacked layout — checkpoints are layout-free)
+    # sees exactly the geometry it sees today.  None keeps the round
+    # program literally unchanged; set via FedavgConfig.resources(
+    # client_packing=...), whose "auto" mode gates eligibility loudly.
+    packing: Any = None
 
     # -- construction -------------------------------------------------------
 
@@ -255,10 +265,22 @@ class FedRound:
         hooks = self._hooks()
         client_keys = jax.random.split(k_train, num_clients)
 
-        updates, client_opt, losses = self.task.local_round_batched(
-            state.server.params, state.client_opt, bx, by, client_keys,
-            malicious, *hooks,
-        )
+        if self.packing is not None:
+            # Lane-packing (parallel/packed.py): P clients per grouped-
+            # kernel vmap lane.  Eligibility (resolve_client_packing)
+            # guarantees every hook is identity here, and the per-client
+            # PRNG streams replicate the unpacked discipline exactly.
+            from blades_tpu.parallel.packed import packed_local_round_batched
+
+            updates, client_opt, losses = packed_local_round_batched(
+                self.task, self.packing.pack, state.server.params,
+                state.client_opt, bx, by, client_keys, malicious,
+            )
+        else:
+            updates, client_opt, losses = self.task.local_round_batched(
+                state.server.params, state.client_opt, bx, by, client_keys,
+                malicious, *hooks,
+            )
         # Drop ghost (padding) lanes before anything consumes the matrix.
         k = self.num_clients
         if k is not None and k < updates.shape[0]:

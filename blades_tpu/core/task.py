@@ -106,6 +106,13 @@ class Task:
     # -- pure compute -------------------------------------------------------
 
     def apply(self, params, x, *, train: bool = False, dropout_key=None):
+        if getattr(self.model, "explicit_dropout", False):
+            # Keyed-dropout models (models/layers.py): masks derive from
+            # fold_in(dropout_key, layer_index) — pack-agnostic, which is
+            # what lets the lane-packing path reproduce them exactly.
+            return self.model.apply(
+                {"params": params}, x, train=train, dropout_key=dropout_key
+            )
         rngs = {"dropout": dropout_key} if dropout_key is not None else None
         return self.model.apply({"params": params}, x, train=train, rngs=rngs)
 

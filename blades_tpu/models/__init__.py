@@ -11,9 +11,11 @@ Input convention is NHWC (TPU-native layout), unlike the reference's NCHW.
 """
 
 from blades_tpu.models.catalog import ModelCatalog, register_model  # noqa: F401
-from blades_tpu.models.mlp import MLP  # noqa: F401
-from blades_tpu.models.cnn import FashionCNN  # noqa: F401
+from blades_tpu.models.layers import PackedDense, keyed_dropout  # noqa: F401
+from blades_tpu.models.mlp import MLP, PackedMLP  # noqa: F401
+from blades_tpu.models.cnn import FashionCNN, PackedFashionCNN  # noqa: F401
 from blades_tpu.models.resnet import (  # noqa: F401
+    PackedResNet,
     ResNet10,
     ResNet18,
     ResNet34,

@@ -5,18 +5,34 @@ conv(3x3, VALID) -> relu -> maxpool (14 -> 12 -> 6 spatial, so fc1 sees
 64*6*6 features), then fc 600 -> dropout(0.25) -> fc 120 -> fc 10 with no
 intermediate nonlinearities — the reference's BatchNorms are commented out
 and its dense stack is linear (ref: cnn.py:11-21, 29-38).  NHWC layout.
+
+Dropout is :func:`~blades_tpu.models.layers.keyed_dropout` with an
+explicit per-call key (``explicit_dropout = True``), the pack-agnostic
+RNG discipline that lets :class:`PackedFashionCNN` reproduce per-client
+masks exactly.
 """
 
 from __future__ import annotations
 
+from typing import ClassVar
+
 import flax.linen as nn
+import jax.numpy as jnp
+
+from blades_tpu.models.layers import (
+    PackedDense,
+    keyed_dropout,
+    packed_keyed_dropout,
+)
 
 
 class FashionCNN(nn.Module):
     num_classes: int = 10
 
+    explicit_dropout: ClassVar[bool] = True
+
     @nn.compact
-    def __call__(self, x, *, train: bool = False):
+    def __call__(self, x, *, train: bool = False, dropout_key=None):
         x = nn.Conv(32, (3, 3), padding=1)(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
@@ -25,6 +41,50 @@ class FashionCNN(nn.Module):
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
         x = x.reshape((x.shape[0], -1))
         x = nn.Dense(600)(x)
-        x = nn.Dropout(0.25, deterministic=not train)(x)
+        x = keyed_dropout(x, 0.25, dropout_key, 0, not train)
         x = nn.Dense(120)(x)
         return nn.Dense(self.num_classes)(x)
+
+
+class PackedFashionCNN(nn.Module):
+    """P clients' CNNs in one lane via grouped kernels.
+
+    Convs run with ``feature_group_count=P`` on channel-concatenated
+    activations (``(B, H, W, C*P)``, client ``g`` owning channels
+    ``[g*C, (g+1)*C)``) — grouped convolution computes output block ``g``
+    from input block ``g`` with kernel slice ``[..., g*C_out:(g+1)*C_out]``,
+    i.e. exactly the per-client convs reassociated.  The flatten
+    de-interleaves channels back to per-client ``(h, w, c)`` order before
+    the :class:`~blades_tpu.models.layers.PackedDense` stack, so each
+    group's feature layout matches the unpacked model's.  Submodule names
+    match :class:`FashionCNN`'s auto-naming (``Conv_0``, ``Dense_0``, ...)
+    so the packed param tree is the structure-preserving pack transform of
+    P client trees.
+    """
+
+    pack: int
+    num_classes: int = 10
+
+    def pack_inputs(self, x):
+        """``(P, B, H, W, C) -> (B, H, W, P*C)`` channel concatenation."""
+        p, b, h, w, c = x.shape
+        return jnp.moveaxis(x, 0, 3).reshape((b, h, w, p * c))
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False, dropout_keys=None):
+        p = self.pack
+        x = nn.Conv(32 * p, (3, 3), padding=1, feature_group_count=p,
+                    name="Conv_0")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64 * p, (3, 3), padding="VALID", feature_group_count=p,
+                    name="Conv_1")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        b, h, w, _ = x.shape
+        x = x.reshape((b, h, w, p, 64)).transpose(0, 3, 1, 2, 4)
+        x = x.reshape((b, p, h * w * 64))
+        x = PackedDense(600, p, name="Dense_0")(x)
+        x = packed_keyed_dropout(x, 0.25, dropout_keys, 0, not train)
+        x = PackedDense(120, p, name="Dense_1")(x)
+        return PackedDense(self.num_classes, p, name="Dense_2")(x)

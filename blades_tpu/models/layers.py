@@ -1,4 +1,5 @@
-"""Shared layers: stateless batch normalisation.
+"""Shared layers: stateless batch normalisation, keyed dropout, and the
+pack-axis dense primitive.
 
 The reference pins ``track_running_stats=False`` on every BatchNorm
 (ref: fllib/models/cifar10/resnet_cifar.py:10-18) so that federated weight
@@ -7,6 +8,21 @@ semantics is *simpler* than the stateful default: normalise by the current
 batch's statistics, carry no state at all.  This keeps model application a
 pure function ``(params, x) -> logits`` — which is what lets per-client
 models be a stacked-params ``vmap``.
+
+**Keyed dropout** (:func:`keyed_dropout`): dropout masks derived from an
+explicit per-call key via ``fold_in(key, layer_index)`` instead of flax's
+scope-path ``make_rng`` folding.  The mask then depends only on
+``(key, layer index)`` — not on the module tree it is called from — which
+is what lets the client lane-packing path (:mod:`blades_tpu.parallel.
+packed`) reproduce each client's masks exactly inside a structurally
+different grouped-kernel module.  Models opting in carry
+``explicit_dropout = True`` and take ``dropout_key=`` as a call argument
+(:meth:`blades_tpu.core.task.Task.apply` routes it).
+
+**PackedDense**: P clients' ``(fin, fout)`` dense layers as one
+``(P, fin, fout)`` block-batched einsum over ``(B, P, fin)`` activations —
+the pack-axis formulation of ``nn.Dense`` (same contraction per group, no
+cross-group terms), sized so narrow per-client matmuls still tile the MXU.
 """
 
 from __future__ import annotations
@@ -83,6 +99,87 @@ def _bn_apply_bwd(eps, res, dy):
 
 
 _bn_apply.defvjp(_bn_apply_fwd, _bn_apply_bwd)
+
+
+def keyed_dropout(x, rate, key, layer_index, deterministic):
+    """Inverted dropout with an explicitly derived mask key.
+
+    ``mask = bernoulli(fold_in(key, layer_index), 1 - rate, x.shape)`` —
+    a pure function of the call-site key and the layer's index, so the
+    packed execution path can regenerate client ``g``'s mask from client
+    ``g``'s key regardless of module structure.  ``deterministic=True``
+    (eval) is the identity and needs no key.
+    """
+    if deterministic or rate == 0.0:
+        return x
+    if key is None:
+        raise ValueError(
+            "train-mode dropout needs an explicit dropout key: pass "
+            "dropout_key= to the model call (Task.apply threads it)"
+        )
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(
+        jax.random.fold_in(key, layer_index), keep, x.shape
+    )
+    return jnp.where(mask, x / keep, jnp.zeros_like(x))
+
+
+def packed_keyed_dropout(x, rate, keys, layer_index, deterministic):
+    """:func:`keyed_dropout` over pack-axis activations ``(B, P, F)``.
+
+    Group ``g``'s mask is ``bernoulli(fold_in(keys[g], layer_index),
+    1 - rate, (B, F))`` — exactly the mask the unpacked model draws for
+    client ``g`` under ``dropout_key = keys[g]``, which is what makes the
+    packed trajectory match the unpacked one beyond fp reassociation.
+    """
+    if deterministic or rate == 0.0:
+        return x
+    if keys is None:
+        raise ValueError(
+            "train-mode packed dropout needs per-group keys: pass "
+            "dropout_keys= (P keys, one per packed client)"
+        )
+    keep = 1.0 - rate
+    batch, _, feat = x.shape
+
+    def one_group(k):
+        return jax.random.bernoulli(
+            jax.random.fold_in(k, layer_index), keep, (batch, feat)
+        )
+
+    mask = jnp.moveaxis(jax.vmap(one_group)(keys), 0, 1)  # (B, P, F)
+    return jnp.where(mask, x / keep, jnp.zeros_like(x))
+
+
+class PackedDense(nn.Module):
+    """P clients' dense layers as one block-batched einsum.
+
+    Params mirror ``nn.Dense`` with a leading pack axis: ``kernel``
+    ``(P, fin, fout)``, ``bias`` ``(P, fout)`` — exactly
+    ``jnp.stack`` of the per-client leaves, which is the pack rule
+    :mod:`blades_tpu.parallel.packed` applies.  Input/output are
+    ``(B, P, fin)`` / ``(B, P, fout)``; group ``g`` only ever contracts
+    with slice ``kernel[g]``, so no activations cross packed clients.
+    """
+
+    features: int
+    pack: int
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        fin = x.shape[-1]
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (self.pack, fin, self.features),
+        )
+        y = jnp.einsum("bpi,pio->bpo", x, kernel.astype(x.dtype))
+        if self.use_bias:
+            bias = self.param(
+                "bias", nn.initializers.zeros, (self.pack, self.features)
+            )
+            y = y + bias.astype(y.dtype)[None]
+        return y
 
 
 class BatchStatsNorm(nn.Module):

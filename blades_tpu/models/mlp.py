@@ -1,9 +1,24 @@
 """MNIST MLP (ref: fllib/models/mnist/mlp.py:5-35): 784-128-256-10,
-dropout 0.2 between hidden layers."""
+dropout 0.2 between hidden layers.
+
+Dropout is :func:`~blades_tpu.models.layers.keyed_dropout` with an
+explicit per-call key (``explicit_dropout = True``; Task.apply threads
+``dropout_key=``), so masks depend only on ``(key, layer index)`` — the
+invariant that lets :class:`PackedMLP` reproduce each packed client's
+masks exactly.
+"""
 
 from __future__ import annotations
 
+from typing import ClassVar
+
 import flax.linen as nn
+
+from blades_tpu.models.layers import (
+    PackedDense,
+    keyed_dropout,
+    packed_keyed_dropout,
+)
 
 
 class MLP(nn.Module):
@@ -12,11 +27,44 @@ class MLP(nn.Module):
     num_classes: int = 10
     dropout_rate: float = 0.2
 
+    explicit_dropout: ClassVar[bool] = True
+
     @nn.compact
-    def __call__(self, x, *, train: bool = False):
+    def __call__(self, x, *, train: bool = False, dropout_key=None):
         x = x.reshape((x.shape[0], -1))
         x = nn.relu(nn.Dense(self.hidden1)(x))
-        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = keyed_dropout(x, self.dropout_rate, dropout_key, 0, not train)
         x = nn.relu(nn.Dense(self.hidden2)(x))
-        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = keyed_dropout(x, self.dropout_rate, dropout_key, 1, not train)
         return nn.Dense(self.num_classes)(x)
+
+
+class PackedMLP(nn.Module):
+    """P clients' MLPs in one lane: every ``Dense_i`` becomes a
+    :class:`~blades_tpu.models.layers.PackedDense` block einsum over
+    ``(B, P, features)`` activations.  Submodule names match
+    :class:`MLP`'s auto-naming, so the packed param tree is the
+    structure-preserving pack transform of P client trees
+    (:mod:`blades_tpu.parallel.packed`)."""
+
+    pack: int
+    hidden1: int = 128
+    hidden2: int = 256
+    num_classes: int = 10
+    dropout_rate: float = 0.2
+
+    def pack_inputs(self, x):
+        """``(P, B, ...) -> (B, P, features)`` — per-client flatten, then
+        the client axis becomes the pack axis."""
+        p, b = x.shape[0], x.shape[1]
+        return x.reshape((p, b, -1)).transpose(1, 0, 2)
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False, dropout_keys=None):
+        x = nn.relu(PackedDense(self.hidden1, self.pack, name="Dense_0")(x))
+        x = packed_keyed_dropout(x, self.dropout_rate, dropout_keys, 0,
+                                 not train)
+        x = nn.relu(PackedDense(self.hidden2, self.pack, name="Dense_1")(x))
+        x = packed_keyed_dropout(x, self.dropout_rate, dropout_keys, 1,
+                                 not train)
+        return PackedDense(self.num_classes, self.pack, name="Dense_2")(x)

@@ -13,9 +13,10 @@ from __future__ import annotations
 from typing import Sequence, Type
 
 import flax.linen as nn
+import jax.numpy as jnp
 from flax.linen import Conv, Dense
 
-from blades_tpu.models.layers import BatchStatsNorm
+from blades_tpu.models.layers import BatchStatsNorm, PackedDense
 
 
 class BasicBlock(nn.Module):
@@ -77,6 +78,73 @@ class ResNet(nn.Module):
                 x = self.block(filters, stride)(x)
         x = x.mean(axis=(1, 2))
         return Dense(self.num_classes)(x)
+
+
+class PackedBasicBlock(nn.Module):
+    """P clients' :class:`BasicBlock`\\ s via ``feature_group_count=P``
+    grouped convs on channel-concatenated activations.  The residual add,
+    relus, and :class:`BatchStatsNorm` are all per-channel — BN statistics
+    are per-channel by construction, so no activations cross packed
+    clients.  Submodule names match the unpacked block's auto-naming."""
+
+    filters: int
+    stride: int = 1
+    pack: int = 2
+
+    @nn.compact
+    def __call__(self, x):
+        p = self.pack
+        residual = x
+        y = Conv(self.filters * p, (3, 3), strides=self.stride, padding=1,
+                 use_bias=False, feature_group_count=p, name="Conv_0")(x)
+        y = nn.relu(BatchStatsNorm(name="BatchStatsNorm_0")(y))
+        y = Conv(self.filters * p, (3, 3), padding=1, use_bias=False,
+                 feature_group_count=p, name="Conv_1")(y)
+        y = BatchStatsNorm(name="BatchStatsNorm_1")(y)
+        if self.stride != 1 or x.shape[-1] != self.filters * p:
+            residual = Conv(self.filters * p, (1, 1), strides=self.stride,
+                            use_bias=False, feature_group_count=p,
+                            name="Conv_2")(x)
+            residual = BatchStatsNorm(name="BatchStatsNorm_2")(residual)
+        return nn.relu(y + residual)
+
+
+class PackedResNet(nn.Module):
+    """P clients' BasicBlock ResNets in one lane (grouped-kernel form of
+    :class:`ResNet`; Bottleneck variants have no packed formulation —
+    their wide stages fail the packing width heuristic anyway).  The
+    global average pool reduces spatial axes only (per-channel), and the
+    head de-interleaves channels into the pack axis for
+    :class:`~blades_tpu.models.layers.PackedDense`."""
+
+    pack: int
+    stage_sizes: Sequence[int]
+    num_classes: int = 10
+
+    def pack_inputs(self, x):
+        """``(P, B, H, W, C) -> (B, H, W, P*C)`` channel concatenation."""
+        p, b, h, w, c = x.shape
+        return jnp.moveaxis(x, 0, 3).reshape((b, h, w, p * c))
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False, dropout_keys=None):
+        del train, dropout_keys  # no dropout / no mutable norm state
+        p = self.pack
+        x = Conv(64 * p, (3, 3), padding=1, use_bias=False,
+                 feature_group_count=p, name="Conv_0")(x)
+        x = nn.relu(BatchStatsNorm(name="BatchStatsNorm_0")(x))
+        idx = 0
+        for i, num_blocks in enumerate(self.stage_sizes):
+            filters = 64 * 2**i
+            for j in range(num_blocks):
+                stride = 2 if i > 0 and j == 0 else 1
+                x = PackedBasicBlock(filters, stride, pack=p,
+                                     name=f"BasicBlock_{idx}")(x)
+                idx += 1
+        x = x.mean(axis=(1, 2))                       # (B, C*P) per-channel
+        b, cp = x.shape
+        x = x.reshape((b, p, cp // p))                # de-interleave groups
+        return PackedDense(self.num_classes, p, name="Dense_0")(x)
 
 
 def ResNet10(num_classes: int = 10) -> ResNet:

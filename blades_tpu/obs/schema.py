@@ -67,6 +67,14 @@ ROUND_RECORD_FIELDS: Dict[str, Tuple[tuple, bool]] = {
     "comm_bytes_up": ((int,), False),
     "codec_bits": ((int,), False),
     "comm_compression_ratio": (_NUM, False),
+    # Client lane-packing (parallel/packed.py): static per-round
+    # provenance stamped host-side when the dense round runs P clients
+    # per grouped-kernel vmap lane.  pack_factor = clients per lane,
+    # packed_lanes = n / pack_factor dispatch lanes.  Absent on unpacked
+    # runs (including "auto" fallbacks, whose reason lands in the sweep
+    # summary's "packing" block instead).
+    "pack_factor": ((int,), False),
+    "packed_lanes": ((int,), False),
     # Malicious-lane training elision (streamed/d-sharded paths): lanes
     # whose training was skipped this round.  Surfaced so the optimistic
     # num_unhealthy basis — elided lanes can never trip health counters —

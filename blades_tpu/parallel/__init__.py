@@ -28,6 +28,12 @@ program:
   wall: bf16 update matrix, client-block ``lax.map`` training, d-chunked
   forge+aggregate (coordinate-wise suite only).
 
+Orthogonally, :mod:`blades_tpu.parallel.packed` raises arithmetic
+intensity PER LANE on the dense path: client lane-packing folds P narrow
+clients into one grouped-kernel vmap lane (``feature_group_count=P``
+convs, pack-axis dense einsum), unpacking back to the dense ``(n, d)``
+matrix before forging/codecs/faults/aggregation.
+
 Multi-host (DCN) attaches via :func:`init_distributed`.
 """
 
@@ -39,5 +45,9 @@ from blades_tpu.parallel.mesh import (  # noqa: F401
     shard_federation,
 )
 from blades_tpu.parallel.dsharded import dsharded_step  # noqa: F401
+from blades_tpu.parallel.packed import (  # noqa: F401
+    ClientPacking,
+    resolve_client_packing,
+)
 from blades_tpu.parallel.sharded import shard_map_step, sharded_step  # noqa: F401
 from blades_tpu.parallel.streamed import streamed_step  # noqa: F401

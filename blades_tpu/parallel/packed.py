@@ -1,0 +1,426 @@
+"""Client lane-packing: P clients' local rounds in one grouped-kernel lane.
+
+The dense round is ``vmap(local_round)`` over clients (core/round.py), so
+a 64-channel FashionCNN lane fills only half of a 128-wide TPU vector
+register / MXU tile.  This module folds ``P`` clients into ONE vmap lane
+by concatenating their parameters along the channel/feature axis and
+running grouped kernels:
+
+- convs become ``feature_group_count=P`` grouped convs on channel-
+  concatenated activations (``models/cnn.py::PackedFashionCNN``,
+  ``models/resnet.py::PackedResNet``) — grouped convolution IS the
+  per-client convs reassociated, exact math;
+- dense layers become the pack-axis einsum ``(B,P,fin) x (P,fin,fout)``
+  (``models/layers.py::PackedDense``);
+- ``BatchStatsNorm`` statistics are per-channel by construction, and the
+  channel axis is partitioned by client — per-group statistics for free,
+  no activations leak across packed clients;
+- dropout masks regenerate per client from explicit keys
+  (``models/layers.py::keyed_dropout`` discipline), bit-identical to the
+  unpacked model's.
+
+**The contract**: pack/unpack are pure pytree transforms applied AROUND
+the local round.  Updates are unpacked back to the dense ``(n, d)``
+matrix before codecs, fault injection, DP, forging, and aggregation —
+every aggregator, adversary, codec, and forensics path sees exactly the
+geometry it sees today, and ``RoundState`` stays in the canonical
+unpacked layout (checkpoints are layout-free; any ``pack_factor`` can
+resume any other).  Differences vs the unpacked round are pure
+fp-reassociation (grouped-kernel lowering), regression-tested per
+aggregator in ``tests/test_packed.py``.
+
+Pack rules (structure-preserving tree maps, keyed on the param path —
+the same remap-by-layout discipline as :mod:`blades_tpu.ops.layout`):
+
+==================  =========================  ==========================
+module              client leaf                packed leaf
+==================  =========================  ==========================
+``Conv_i``          kernel ``(kh,kw,ci,co)``   concat -> ``(kh,kw,ci,P*co)``
+``Conv_i``          bias ``(co,)``             concat -> ``(P*co,)``
+``BatchStatsNorm``  scale/bias ``(c,)``        concat -> ``(P*c,)``
+``Dense_i``         kernel ``(fi,fo)``         stack  -> ``(P,fi,fo)``
+``Dense_i``         bias ``(fo,)``             stack  -> ``(P,fo)``
+==================  =========================  ==========================
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+import warnings
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.flatten_util import ravel_pytree
+
+# Vector-register / MXU tile width the eligibility heuristic packs up to.
+LANE_WIDTH = 128
+
+_CONCAT_RE = re.compile(r"^(Conv|BatchStatsNorm)_\d+$")
+_STACK_RE = re.compile(r"^Dense_\d+$")
+
+
+@dataclasses.dataclass(frozen=True)
+class ClientPacking:
+    """Static packing spec threaded through :class:`~blades_tpu.core.
+    round.FedRound` (hashable jit config)."""
+
+    pack: int
+
+
+class PackingUnsupported(ValueError):
+    """The model/config has no packed formulation (loud fallback)."""
+
+
+# ---------------------------------------------------------------------------
+# pack / unpack: pure pytree transforms
+# ---------------------------------------------------------------------------
+
+
+def _path_rule(path) -> str:
+    """'concat' | 'stack' for a param-tree leaf path.
+
+    The LAST path segment naming a packable module decides (optimizer
+    states nest the params tree under namedtuple fields, so scanning all
+    segments keeps the rule working for stacked opt-state leaves too).
+    """
+    rule = None
+    for entry in path:
+        key = getattr(entry, "key", None)
+        if not isinstance(key, str):
+            continue
+        if _CONCAT_RE.match(key):
+            rule = "concat"
+        elif _STACK_RE.match(key):
+            rule = "stack"
+    if rule is None:
+        raise PackingUnsupported(
+            f"param path {jax.tree_util.keystr(tuple(path))!r} belongs to "
+            "no packable module (Conv/Dense/BatchStatsNorm)"
+        )
+    return rule
+
+
+def pack_replicated(params: Any, pack: int) -> Any:
+    """Pack P copies of the GLOBAL params (every client starts the round
+    from the same weights, so packing is replication)."""
+
+    def leaf(path, x):
+        if _path_rule(path) == "concat":
+            reps = (1,) * (x.ndim - 1) + (pack,)
+            return jnp.tile(x, reps)
+        return jnp.broadcast_to(x, (pack,) + x.shape)
+
+    return jax.tree_util.tree_map_with_path(leaf, params)
+
+
+def pack_stacked(tree: Any, pack: int) -> Any:
+    """Pack a client-stacked tree: leaves ``(n, *s)`` -> lane-stacked
+    packed leaves (``L = n // pack`` leading)."""
+
+    def leaf(path, x):
+        lanes = x.shape[0] // pack
+        x = x.reshape((lanes, pack) + x.shape[1:])
+        if _path_rule(path) == "concat":
+            # (L, P, ..., c) -> (L, ..., P, c) -> (L, ..., P*c)
+            x = jnp.moveaxis(x, 1, -2)
+            return x.reshape(x.shape[:-2] + (pack * x.shape[-1],))
+        return x  # stack: the (L, P, ...) layout IS the packed layout
+
+    return jax.tree_util.tree_map_with_path(leaf, tree)
+
+
+def unpack_stacked(tree: Any, pack: int) -> Any:
+    """Inverse of :func:`pack_stacked`: lane-stacked packed leaves back to
+    the canonical client-stacked ``(n, *s)`` layout (exact)."""
+
+    def leaf(path, x):
+        if _path_rule(path) == "concat":
+            x = x.reshape(x.shape[:-1] + (pack, x.shape[-1] // pack))
+            x = jnp.moveaxis(x, -2, 1)
+        n = x.shape[0] * pack
+        return x.reshape((n,) + x.shape[2:])
+
+    return jax.tree_util.tree_map_with_path(leaf, tree)
+
+
+def unpack_tree(tree: Any, pack: int) -> Any:
+    """Unpack ONE lane's packed tree to per-client leaves ``(P, *s)``."""
+
+    def leaf(path, x):
+        if _path_rule(path) == "concat":
+            x = x.reshape(x.shape[:-1] + (pack, x.shape[-1] // pack))
+            return jnp.moveaxis(x, -2, 0)
+        return x  # stack: leading axis already IS the pack axis
+
+    return jax.tree_util.tree_map_with_path(leaf, tree)
+
+
+# ---------------------------------------------------------------------------
+# packed model construction
+# ---------------------------------------------------------------------------
+
+
+def build_packed_model(model, pack: int):
+    """Resolve a supported model to its grouped-kernel packed counterpart
+    (same param-tree structure, packed leaf shapes)."""
+    from blades_tpu.models.cnn import FashionCNN, PackedFashionCNN
+    from blades_tpu.models.mlp import MLP, PackedMLP
+    from blades_tpu.models.resnet import BasicBlock, PackedResNet, ResNet
+
+    if isinstance(model, MLP):
+        return PackedMLP(pack=pack, hidden1=model.hidden1,
+                         hidden2=model.hidden2,
+                         num_classes=model.num_classes,
+                         dropout_rate=model.dropout_rate)
+    if isinstance(model, FashionCNN):
+        return PackedFashionCNN(pack=pack, num_classes=model.num_classes)
+    if isinstance(model, ResNet):
+        if model.block is not BasicBlock:
+            raise PackingUnsupported(
+                "only BasicBlock ResNets have a packed formulation "
+                "(Bottleneck stages fail the width heuristic regardless)"
+            )
+        return PackedResNet(pack=pack, stage_sizes=tuple(model.stage_sizes),
+                            num_classes=model.num_classes)
+    raise PackingUnsupported(
+        f"model {type(model).__name__} has no packed formulation "
+        "(supported: MLP, FashionCNN, BasicBlock ResNets)"
+    )
+
+
+def _feature_widths(task) -> Tuple[Tuple[int, ...], Tuple[int, ...]]:
+    """(conv channel widths, dense feature widths) of one client's model,
+    from param SHAPES only (``eval_shape`` — no compute, no compile)."""
+    shapes = jax.eval_shape(task.init_params, jax.random.PRNGKey(0))
+    conv, dense = [], []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(shapes)[0]:
+        names = [getattr(e, "key", None) for e in path]
+        if names and names[-1] == "kernel":
+            if leaf.ndim == 4:
+                conv.append(int(leaf.shape[-1]))
+            elif leaf.ndim == 2:
+                dense.append(int(leaf.shape[-1]))
+    return tuple(conv), tuple(dense)
+
+
+# ---------------------------------------------------------------------------
+# eligibility: the "auto" heuristic + loud fallback
+# ---------------------------------------------------------------------------
+
+
+def resolve_client_packing(
+    fed_round,
+    requested,
+    *,
+    num_clients: int,
+    num_devices: Optional[int] = None,
+    execution: str = "auto",
+) -> Tuple[Any, Optional[dict]]:
+    """Resolve a ``client_packing`` request against this round's config.
+
+    ``requested``: ``"off"``/``None``/``1`` (no packing, silent),
+    ``"auto"`` (pack iff eligible, LOUD ``warnings.warn`` fallback with
+    the reason otherwise), or an int ``P >= 2`` (forced: structural
+    impossibilities raise, the perf width heuristic is advisory only).
+
+    Auto eligibility — all of:
+
+    - ``num_clients % P == 0`` (P = 2 under auto);
+    - dense single-chip execution, no mesh;
+    - the model has a packed formulation and the adversary/callbacks
+      don't hook local training (update-forging adversaries like
+      ALIE/IPM run post-unpack and compose unchanged);
+    - width heuristic: the model's MINIMUM channel width ``* P <= 128``
+      (some layer underfills a vreg — there is width to reclaim) AND its
+      MAXIMUM width ``* P <= 128`` (no wide stage overflows the lane
+      after packing — ResNet-18's 512-channel stages fall back here).
+
+    Returns ``(fed_round', decision)`` where ``decision`` is the
+    operator-facing dict sweep summaries surface (``requested``,
+    ``pack_factor``, ``packed_lanes``, ``fallback`` reason or None).
+    """
+    if requested in (None, "off", False, 1):
+        return fed_round, None
+    auto = requested == "auto"
+    if not auto:
+        try:
+            pack = int(requested)
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"client_packing must be 'off', 'auto' or an int >= 2, "
+                f"got {requested!r}"
+            )
+        if pack < 2:
+            raise ValueError(f"client_packing int must be >= 2, got {pack}")
+    else:
+        pack = 2
+
+    def fallback(reason: str):
+        if not auto:
+            raise PackingUnsupported(
+                f"client_packing={requested!r} cannot run: {reason}"
+            )
+        warnings.warn(
+            f"client_packing='auto' falling back to unpacked execution: "
+            f"{reason}", RuntimeWarning, stacklevel=3,
+        )
+        return fed_round, {"requested": requested, "pack_factor": 1,
+                           "packed_lanes": num_clients, "fallback": reason}
+
+    if num_devices and num_devices > 1:
+        return fallback("lane packing is single-chip (no mesh formulation)")
+    if execution in ("streamed", "dsharded"):
+        return fallback(
+            f"lane packing needs the dense round, not execution="
+            f"{execution!r}"
+        )
+    if num_clients % pack:
+        return fallback(
+            f"num_clients={num_clients} is not divisible by pack_factor="
+            f"{pack}"
+        )
+    adv = fed_round.adversary
+    if adv is not None:
+        from blades_tpu.adversaries.base import Adversary
+
+        hooked = (type(adv).data_hook is not Adversary.data_hook
+                  or type(adv).grad_hook is not Adversary.grad_hook)
+        if hooked:
+            return fallback(
+                f"adversary {type(adv).__name__} hooks local training "
+                "(data/grad hooks run per client inside the lane); only "
+                "update-forging adversaries compose with packing"
+            )
+    if fed_round.client_callbacks:
+        return fallback(
+            "client callbacks hook local training per client; the packed "
+            "lane has no per-client callback formulation"
+        )
+    try:
+        build_packed_model(fed_round.task.model, pack)
+    except PackingUnsupported as exc:
+        return fallback(str(exc))
+    if auto:
+        conv, dense = _feature_widths(fed_round.task)
+        widths = conv or dense
+        if not widths:
+            return fallback("model exposes no packable feature widths")
+        if min(widths) * pack > LANE_WIDTH:
+            return fallback(
+                f"narrowest layer ({min(widths)} channels) already fills "
+                f"a {LANE_WIDTH}-lane vreg at pack_factor={pack} — "
+                "nothing to reclaim"
+            )
+        if max(widths) * pack > LANE_WIDTH:
+            return fallback(
+                f"wide stages ({max(widths)} channels x pack_factor="
+                f"{pack} > {LANE_WIDTH} lanes) would overflow the vreg "
+                "tile and regress"
+            )
+    fed_round = dataclasses.replace(fed_round,
+                                    packing=ClientPacking(pack=pack))
+    return fed_round, {"requested": requested, "pack_factor": pack,
+                       "packed_lanes": num_clients // pack, "fallback": None}
+
+
+# ---------------------------------------------------------------------------
+# the packed local round
+# ---------------------------------------------------------------------------
+
+
+def packed_local_round_batched(
+    task,
+    pack: int,
+    global_params,
+    opt_states,
+    batches_x,
+    batches_y,
+    client_keys,
+    malicious,
+):
+    """Grouped-kernel replacement for ``Task.local_round_batched``.
+
+    Same inputs/outputs as the unpacked path — ``(n, nb, B, ...)``
+    batches in, ``(updates (n, d), new_opt_states, losses (n,))`` out, in
+    canonical client order — with clients ``[l*P, (l+1)*P)`` fused into
+    vmap lane ``l``.  Per-client PRNG streams (batch keys, augmentation
+    splits, dropout masks) replicate the unpacked discipline exactly;
+    remaining differences are grouped-kernel fp reassociation.
+
+    Only hook-free rounds reach this path (``resolve_client_packing``
+    gates out training-side adversaries and client callbacks), so
+    ``malicious`` only rides along for signature parity.
+    """
+    del malicious  # hooks are identity on this path (eligibility-gated)
+    from blades_tpu.data.augment import get_augmentation
+
+    n = batches_x.shape[0]
+    lanes = n // pack
+    pm = build_packed_model(task.model, pack)
+    packed_global = pack_replicated(global_params, pack)
+    packed_opt = pack_stacked(opt_states, pack)
+    ravel = lambda t: ravel_pytree(t)[0]  # noqa: E731
+    aug = get_augmentation(task.spec.augment)
+    optimizer = task.client_optimizer()
+    clamp = task.spec.loss_clamp
+    compute_dt = (None if task.spec.compute_dtype is None
+                  else jnp.dtype(task.spec.compute_dtype))
+
+    bx = batches_x.reshape((lanes, pack) + batches_x.shape[1:])
+    by = batches_y.reshape((lanes, pack) + batches_y.shape[1:])
+    keys = client_keys.reshape((lanes, pack) + client_keys.shape[1:])
+
+    def lane(opt_state, bxl, byl, ks):
+        nb = bxl.shape[1]
+        # Per-client per-batch keys, the unpacked split discipline:
+        # keys = split(client_key, num_batches), scanned batch-major.
+        bkeys = jnp.moveaxis(
+            jax.vmap(lambda k: jax.random.split(k, nb))(ks), 1, 0)
+        xs = jnp.moveaxis(bxl, 1, 0)  # (nb, P, B, ...)
+        ys = jnp.moveaxis(byl, 1, 0)
+
+        def step(carry, inp):
+            params_p, opt_state = carry
+            x, y, k = inp  # (P, B, ...), (P, B), (P, key)
+            if aug is not None:
+                # Unpacked order: k_aug, key = split(key); augment first.
+                kk = jax.vmap(jax.random.split)(k)
+                x = jax.vmap(aug)(kk[:, 0], x)
+                k = kk[:, 1]
+
+            def loss_fn(pp):
+                xx = x
+                if compute_dt is not None:
+                    pp = jax.tree.map(
+                        lambda a: a.astype(compute_dt)
+                        if jnp.issubdtype(a.dtype, jnp.floating) else a, pp)
+                    xx = xx.astype(compute_dt)
+                logits = pm.apply({"params": pp}, pm.pack_inputs(xx),
+                                  train=True, dropout_keys=k)
+                # (B, P, K) -> per-group batch-mean CE, clipped per group
+                # (groups' params are disjoint, so the summed loss yields
+                # exactly each client's clipped-CE gradient).
+                ce = optax.softmax_cross_entropy_with_integer_labels(
+                    jnp.moveaxis(logits.astype(jnp.float32), 1, 0), y)
+                ce_g = jnp.clip(ce.mean(axis=1), 0.0, clamp)
+                return ce_g.sum(), ce_g
+
+            (_, losses_g), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params_p)
+            updates, opt_state = optimizer.update(grads, opt_state, params_p)
+            params_p = optax.apply_updates(params_p, updates)
+            return (params_p, opt_state), losses_g
+
+        (params_p, opt_state), losses = jax.lax.scan(
+            step, (packed_global, opt_state), (xs, ys, bkeys))
+        delta = jax.tree.map(lambda a, b: a - b, params_p, packed_global)
+        upd = jax.vmap(ravel)(unpack_tree(delta, pack))  # (P, d)
+        return upd, opt_state, losses.mean(axis=0)
+
+    updates, new_opt, losses = jax.vmap(lane)(packed_opt, bx, by, keys)
+    return (updates.reshape((n, updates.shape[-1])),
+            unpack_stacked(new_opt, pack),
+            losses.reshape((n,)))

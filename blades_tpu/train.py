@@ -104,6 +104,12 @@ def main(argv=None) -> int:
                        '{"type": "topk", "topk_ratio": 0.01}}\' '
                        '(see README "Communication codecs")')
     p_run.add_argument("--rounds", type=int, default=100)
+    p_run.add_argument("--client-packing", default=None, metavar="P",
+                       help="client lane-packing on the dense round: "
+                       "'auto' (pack 2 clients per grouped-kernel lane "
+                       "iff the width/divisibility heuristic passes, loud "
+                       "fallback otherwise), an int P>=2 to force, 'off' "
+                       "(default; see README \"Client packing\")")
 
     args = parser.parse_args(argv)
     scan_window = (args.scan_window if args.scan_window == "auto"
@@ -141,11 +147,16 @@ def main(argv=None) -> int:
             )
 
     else:
+        run_config = json.loads(args.config_json)
+        if args.client_packing is not None:
+            cp = args.client_packing
+            run_config["client_packing"] = (cp if cp in ("auto", "off")
+                                            else int(cp))
         experiments = {
             f"{args.algo.lower()}_run": {
                 "run": args.algo,
                 "stop": {"training_iteration": args.rounds},
-                "config": json.loads(args.config_json),
+                "config": run_config,
             }
         }
 

@@ -231,6 +231,12 @@ def run_lanes(
 
         d_model = tree_size(states.server.params) // L  # per-lane width
         comm_row = fr.codec.round_metrics(base.num_clients, d_model)
+    if fr.packing is not None:
+        # Lane-packing provenance (parallel/packed.py): static shared
+        # config, stamped into every laned row like the codec accounting.
+        comm_row = dict(comm_row)
+        comm_row["pack_factor"] = int(fr.packing.pack)
+        comm_row["packed_lanes"] = int(base.num_clients // fr.packing.pack)
 
     def lane_step(state, x, y, ln, mal, key, sc):
         return _apply_lane(fr, sc).step(state, x, y, ln, mal, key)

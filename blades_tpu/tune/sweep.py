@@ -493,7 +493,20 @@ def _run_lane_group(
         comm = _comm_summary(rows[-1] if rows else {})
         if comm:
             out[i]["comm"] = comm
+        packing = _packing_summary(rows[-1] if rows else {})
+        if packing:
+            out[i]["packing"] = packing
     return out
+
+
+def _packing_summary(row: Dict) -> Optional[Dict]:
+    """The lane-packing provenance slice for laned-trial summaries (the
+    stamps are static per round, so the last row stands for the
+    trial; sequential trials carry the fuller decision dict from
+    ``algo.packing_summary`` instead)."""
+    packing = {k: row[k] for k in ("pack_factor", "packed_lanes")
+               if k in row}
+    return packing or None
 
 
 def _comm_summary(row: Dict) -> Optional[Dict]:
@@ -950,6 +963,13 @@ def run_experiments(
                 # Codec byte accounting (blades_tpu/comm), mirrored from
                 # the per-round metrics stream into the trial summary.
                 summary["comm"] = comm
+            packing = getattr(algo, "packing_summary", None)
+            if packing:
+                # Lane-packing decision (parallel/packed.py): present
+                # whenever packing was REQUESTED — a fallback shows
+                # pack_factor 1 plus the reason, so operators can tell
+                # packed from unpacked runs without reading logs.
+                summary["packing"] = packing
             if scan_w > 1:
                 summary["scan_window"] = scan_w
             if (cost_analysis and failed_error is None

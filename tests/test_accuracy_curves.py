@@ -2,7 +2,10 @@
 
 import json
 
+import pytest
 
+
+@pytest.mark.slow  # full curve-harness sweep (~20 s; the harness is also driven by the resume test in the slow lane)
 def test_accuracy_curves_one_command(tmp_path):
     from blades_tpu.benchmarks.accuracy_curves import main
 
@@ -28,6 +31,7 @@ def test_accuracy_curves_one_command(tmp_path):
     assert png[:8] == b"\x89PNG\r\n\x1a\n"
 
 
+@pytest.mark.slow  # second full grid run (~16 s; the one-command path stays tier-1)
 def test_resume_from_completes_a_grid(tmp_path):
     """--resume-from seeds prior cells, skips them, and the stitched
     table/plot cover the union (the mechanism for completing the IPM

@@ -6,6 +6,7 @@ import dataclasses
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from blades_tpu.core import FedRound, Server, TaskSpec
 from blades_tpu.core.callbacks import (
@@ -77,6 +78,7 @@ def test_round_end_hook_edits_update():
     assert float(m["update_norm_mean"]) == 0.0  # every update zeroed
 
 
+@pytest.mark.slow  # full sweep from YAML (~9 s; the callback chain itself stays tier-1)
 def test_clipping_from_yaml_config(tmp_path):
     """The reference's local20 envelope: clipping configurable from YAML
     (client_config.callbacks), and it measurably bounds update norms."""

@@ -222,6 +222,7 @@ def test_clustering_scales_to_1000(linkage):
     assert mask.sum() == 750
 
 
+@pytest.mark.slow  # 1000-client clustering compile (~12 s; small-n equivalence stays tier-1)
 def test_clippedclustering_aggregates_1000_clients():
     """The full Clippedclustering aggregator at the north-star client
     count: must run (and fast) now that the merge loop is gone."""

@@ -222,10 +222,10 @@ def test_round_metrics_fields_schema_valid():
 # identity codec: bit-transparent per aggregator
 # ---------------------------------------------------------------------------
 
-# Tier-1 runs the headline aggregators (same budget rationale as
-# tests/test_perf.py's identity sweep); the rest of the registry runs the
-# identical check in the full suite.
-_T1_AGGREGATORS = ("Mean", "Median")
+# Tier-1 runs ONE headline aggregator (PR 7 budget rebalance: each case
+# compiles two MLP round programs, ~8 s here); the rest of the registry
+# runs the identical check in the full suite (`pytest tests/`).
+_T1_AGGREGATORS = ("Mean",)
 
 
 def _tiny_round(agg_name, codec=None, faults=None, **kw):

@@ -203,6 +203,7 @@ def test_sweep_marks_trial_failed_and_continues(tmp_path, flaky_registry):
     assert summaries[1]["rounds"] == 8
 
 
+@pytest.mark.slow
 def test_dsharded_health_check_detects_and_recovers():
     """Cross-shard row health on the width-sharded giant-federation path:
     a NaN client lane is detected via psum over its shards, zeroed, and

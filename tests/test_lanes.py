@@ -3,6 +3,7 @@ driver (SURVEY.md §2.9 — the reference runs Tune trials concurrently on a
 Ray cluster; here the canonical seed sweep is one vmapped program)."""
 
 import numpy as np
+import pytest
 
 from blades_tpu.algorithms import get_algorithm_class
 from blades_tpu.tune import run_seed_lanes
@@ -75,6 +76,7 @@ def _dp_experiment(rounds, seeds, epsilons):
     }
 
 
+@pytest.mark.slow  # 3-lane DP grid + sequential replays (~34 s; seed-lane parity stays tier-1)
 def test_dp_grid_runs_as_lanes_with_result_parity(tmp_path):
     """The r2 'done' bar: the DP epsilon x seed grid runs as ONE vmapped
     lane group from the YAML-shaped experiment path, with per-row result

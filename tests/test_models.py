@@ -105,6 +105,7 @@ def test_models_are_pure_no_mutable_collections():
     assert set(variables.keys()) == {"params"}
 
 
+@pytest.mark.slow  # compiles every CCT/CVT variant (~27 s; catalog forward shapes stay tier-1)
 def test_cct_cvt_variant_zoo():
     """The full named variant surface (ref: cctnets/cct.py:203-658,
     cvt.py:138-321): every 32x32 variant builds and runs forward."""

@@ -405,6 +405,7 @@ def test_forensics_rejects_sharded_paths():
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow  # compile-heavy end-to-end sweep (~45 s tier-1 time; PR 7 budget rebalance)
 def test_sweep_alie_emits_schema_valid_forensics_jsonl(tmp_path):
     """20-round synthetic ALIE sweep over Krum/DnC/SignGuard/trimmed-mean:
     every trial streams 20 schema-valid JSONL records carrying per-round
@@ -494,6 +495,7 @@ def test_sweep_laned_trials_emit_schema_valid_jsonl(tmp_path):
         assert "seed" in row and row["experiment"] == "laned"
 
 
+@pytest.mark.slow  # CLI end-to-end with tracing (~17 s; the run-subcommand surface stays covered by test_tune)
 def test_cli_run_honours_trace_and_metrics_csv(tmp_path, monkeypatch):
     """Satellite: the run subcommand used to silently ignore --trace."""
     import blades_tpu.tune as tune_mod

@@ -257,6 +257,7 @@ def test_compact_matches_full_kernel(nb, mult, d, forge, agg):
     assert not np.asarray(bad_c).any()
 
 
+@pytest.mark.slow  # duplicate compact-kernel compile fixture (~10 s; the f32 compact/full equivalence stays tier-1)
 def test_compact_bf16_matches_full_bf16():
     from blades_tpu.ops.pallas_round import fused_finish_compact
 
@@ -295,6 +296,7 @@ def test_compact_adaptive_matches_full():
                                atol=1e-5, rtol=1e-5)
 
 
+@pytest.mark.slow  # interpret-mode MXU variant sweep (~43 s; PR 7 budget rebalance)
 def test_compact_mxu_variants_match_default():
     """The MXU radix-count formulation must be BIT-exact vs the VPU one
     (the per-step counts are small integers, exact in f32); the MXU
@@ -429,6 +431,7 @@ def test_streamed_step_compact_branch_matches_chunked(monkeypatch):
                                    atol=1e-6)
 
 
+@pytest.mark.slow  # duplicate compact-kernel compile fixture (~8 s; matches_full_kernel stays tier-1)
 def test_compact_caller_prepadded_rows_match_autopad():
     """num_real + caller +inf padding (the no-copy giant-scale path) must
     equal the concat-padding path."""
@@ -460,6 +463,7 @@ def test_compact_caller_prepadded_rows_match_autopad():
                                    rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow  # duplicate compact-kernel compile fixture (~6 s)
 def test_streamed_step_compact_with_row_padding(monkeypatch):
     """Compact streamed round where nb is NOT a sublane multiple: the
     pre-padded +inf rows must be invisible (parity vs chunked)."""

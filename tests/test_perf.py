@@ -213,12 +213,13 @@ def test_prefetch_bit_identity_fedavg_driver():
         np.testing.assert_array_equal(p_on, p_off)
 
 
-# Tier-1 runs the headline aggregators (the BASELINE.json workload slice
-# + the trusted-row special case); the rest of the registry runs the
-# identical check in the full suite (`pytest tests/`) — two separately
-# compiled programs per aggregator is the irreducible cost, and the
-# 870 s tier-1 budget on this 2-core box cannot absorb all ten.
-_T1_AGGREGATORS = ("Mean", "Median", "Trimmedmean", "FLTrust")
+# Tier-1 runs the headline aggregator only; the rest of the registry
+# runs the identical check in the full suite (`pytest tests/`) — two
+# separately compiled programs per aggregator is the irreducible cost
+# (~10-14 s/case here), and the 870 s tier-1 budget on this 2-core box
+# cannot absorb them (PR 7 rebalance; this box's wall-clock swings ~2x
+# run to run, so tier-1 must carry real headroom under the cap).
+_T1_AGGREGATORS = ("Mean",)
 
 
 @pytest.mark.parametrize("agg_name", [

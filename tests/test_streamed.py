@@ -37,7 +37,9 @@ def data():
 @pytest.mark.parametrize("aggregator,adversary", [
     ("Median", "ALIE"),
     ("Mean", "IPM"),
-    ("Trimmedmean", "ALIE"),
+    # Same streamed-vs-dense fixture at ~9 s/case; tier-1 keeps two
+    # distinct aggregator/adversary shapes (PR 7 budget rebalance).
+    pytest.param("Trimmedmean", "ALIE", marks=pytest.mark.slow),
 ])
 def test_streamed_matches_dense_f32(data, aggregator, adversary):
     """f32 storage + deterministic coordinate-wise attacks: the chunked
@@ -152,8 +154,11 @@ def test_streamed_dp_noise_is_applied(data):
 
 @pytest.mark.parametrize("aggregator,adversary", [
     ("Median", "ALIE"),          # fused-eligible coordinate path (chunked on CPU)
-    ("GeoMed", "IPM"),           # row-geometry aggregator, coordinate forge
-    ("Median", "MinMax"),        # row-geometry forge, coordinate aggregator
+    # The row-geometry combinations compile near-identical streamed
+    # programs (~8 s each on this box); tier-1 keeps the headline pair,
+    # the full suite runs all three (PR 7 budget rebalance).
+    pytest.param("GeoMed", "IPM", marks=pytest.mark.slow),
+    pytest.param("Median", "MinMax", marks=pytest.mark.slow),
 ])
 def test_malicious_prefix_elision_is_exact(data, aggregator, adversary):
     """Skipping the dead malicious-lane training blocks must reproduce the

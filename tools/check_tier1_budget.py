@@ -1,0 +1,244 @@
+#!/usr/bin/env python
+"""Tier-1 wall-time guard + slow-marker audit.
+
+Two checks, both runnable from CI and exercised by ``tests/test_tools.py``:
+
+1. **Budget guard** (``--log``): parse a tier-1 pytest log (the
+   ``tee /tmp/_t1.log`` stream ROADMAP.md's verify command writes,
+   ideally produced with ``--durations=N``) and FAIL when the projected
+   tier-1 wall time exceeds ``--threshold`` (default 85%) of the
+   ``--cap`` (default 870 s, the driver's timeout).  The projection
+   prefers pytest's own summary total ("... in 823.70s"); when the log
+   only carries ``--durations`` lines (e.g. a partial run), their sum
+   stands in.  Failing at 85% leaves headroom for box-speed variance
+   before the hard timeout kills the run mid-suite.
+
+2. **Marker audit** (``--tests-dir``): AST-scan the test tree for tests
+   that construct or consume the 8-virtual-device mesh —
+   a fixture or test body calling ``make_mesh`` / ``shard_federation``,
+   or requesting a module-local fixture that does — WITHOUT a ``slow``
+   marker (module ``pytestmark``, decorator, or the fixture itself being
+   used only by marked tests).  Mesh compiles are the single most
+   expensive test class on this box; an unmarked one silently eats the
+   tier-1 budget.
+
+Exit code 0 = all checks pass; 1 = violation; 2 = usage/parse error.
+
+Usage::
+
+    python tools/check_tier1_budget.py --log /tmp/_t1.log
+    python tools/check_tier1_budget.py --audit-only
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import re
+import sys
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+CAP_SECONDS = 870.0
+THRESHOLD = 0.85
+MESH_CALLS = {"make_mesh", "shard_federation"}
+
+_DURATION_RE = re.compile(
+    r"^\s*(\d+(?:\.\d+)?)s\s+(call|setup|teardown)\s+(\S+)"
+)
+# pytest summary: "== 359 passed, 3 skipped in 823.70s (0:13:43) =="
+_TOTAL_RE = re.compile(r"\bin\s+(\d+(?:\.\d+)?)s\b")
+
+
+# ---------------------------------------------------------------------------
+# budget guard
+# ---------------------------------------------------------------------------
+
+
+def parse_durations(text: str) -> List[Tuple[float, str, str]]:
+    """``--durations`` lines as ``(seconds, phase, test id)``."""
+    out = []
+    for line in text.splitlines():
+        m = _DURATION_RE.match(line)
+        if m:
+            out.append((float(m.group(1)), m.group(2), m.group(3)))
+    return out
+
+
+def parse_total_seconds(text: str) -> Optional[float]:
+    """The wall total from pytest's final summary line, if present."""
+    total = None
+    for line in text.splitlines():
+        if ("passed" in line or "failed" in line or "error" in line) and (
+            line.strip().startswith("=") or " in " in line
+        ):
+            m = _TOTAL_RE.search(line)
+            if m:
+                total = float(m.group(1))
+    return total
+
+
+def projected_tier1_seconds(text: str) -> Tuple[Optional[float], str]:
+    """(projection, provenance) for a tier-1 log."""
+    total = parse_total_seconds(text)
+    if total is not None:
+        return total, "pytest summary wall total"
+    durations = parse_durations(text)
+    if durations:
+        return sum(d[0] for d in durations), (
+            f"sum of {len(durations)} --durations entries (no summary "
+            "line found — partial log?)"
+        )
+    return None, "no pytest summary or --durations lines found"
+
+
+def check_budget(log_path: Path, cap: float, threshold: float) -> List[str]:
+    """Violation messages (empty = within budget)."""
+    try:
+        text = log_path.read_text(errors="replace")
+    except OSError as exc:
+        return [f"cannot read {log_path}: {exc}"]
+    projected, provenance = projected_tier1_seconds(text)
+    if projected is None:
+        return [f"{log_path}: {provenance}"]
+    budget = cap * threshold
+    print(f"tier-1 projection: {projected:.1f}s ({provenance}); "
+          f"budget {budget:.1f}s = {threshold:.0%} of the {cap:.0f}s cap")
+    if projected > budget:
+        heavy = sorted(parse_durations(text), reverse=True)[:10]
+        hints = "".join(f"\n    {s:7.1f}s {phase:8s} {tid}"
+                        for s, phase, tid in heavy)
+        return [
+            f"projected tier-1 time {projected:.1f}s exceeds "
+            f"{threshold:.0%} of the {cap:.0f}s cap ({budget:.1f}s) — "
+            f"move compile-heavy cases to the slow lane.  Heaviest:"
+            + (hints or " (no --durations in log)")
+        ]
+    return []
+
+
+# ---------------------------------------------------------------------------
+# marker audit
+# ---------------------------------------------------------------------------
+
+
+def _has_slow_mark(deco_list) -> bool:
+    for d in deco_list:
+        for node in ast.walk(d):
+            if isinstance(node, ast.Attribute) and node.attr == "slow":
+                return True
+    return False
+
+
+def _is_fixture(deco_list) -> bool:
+    for d in deco_list:
+        for node in ast.walk(d):
+            if isinstance(node, ast.Attribute) and node.attr == "fixture":
+                return True
+            if isinstance(node, ast.Name) and node.id == "fixture":
+                return True
+    return False
+
+
+def _module_slow(tree: ast.Module) -> bool:
+    """``pytestmark = pytest.mark.slow`` (or a list containing it)."""
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == "pytestmark"
+            for t in node.targets
+        ):
+            for sub in ast.walk(node.value):
+                if isinstance(sub, ast.Attribute) and sub.attr == "slow":
+                    return True
+    return False
+
+
+def _calls_mesh(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            f = node.func
+            name = f.id if isinstance(f, ast.Name) else (
+                f.attr if isinstance(f, ast.Attribute) else None)
+            if name in MESH_CALLS:
+                return True
+    return False
+
+
+def audit_file(path: Path) -> List[str]:
+    """Unmarked mesh tests in one file (violation messages)."""
+    try:
+        tree = ast.parse(path.read_text(), filename=str(path))
+    except SyntaxError as exc:
+        return [f"{path}: unparseable ({exc})"]
+    if _module_slow(tree):
+        return []
+    mesh_fixtures = set()
+    functions = [n for n in ast.walk(tree)
+                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    for fn in functions:
+        if _is_fixture(fn.decorator_list) and _calls_mesh(fn):
+            mesh_fixtures.add(fn.name)
+    violations = []
+    for fn in functions:
+        if not fn.name.startswith("test"):
+            continue
+        if _has_slow_mark(fn.decorator_list):
+            continue
+        args = {a.arg for a in fn.args.args}
+        uses_mesh = _calls_mesh(fn) or (args & mesh_fixtures)
+        if uses_mesh:
+            via = (f"fixture {sorted(args & mesh_fixtures)[0]!r}"
+                   if args & mesh_fixtures else "direct mesh call")
+            violations.append(
+                f"{path.name}::{fn.name}: builds the 8-device mesh "
+                f"({via}) without @pytest.mark.slow"
+            )
+    return violations
+
+
+def check_markers(tests_dir: Path) -> List[str]:
+    violations: List[str] = []
+    for path in sorted(tests_dir.glob("test_*.py")):
+        violations.extend(audit_file(path))
+    return violations
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="check_tier1_budget",
+        description="tier-1 wall-time guard + slow-marker audit",
+    )
+    p.add_argument("--log", default="/tmp/_t1.log",
+                   help="tier-1 pytest log (from the ROADMAP verify "
+                   "command's tee; add --durations=N for hotspot hints)")
+    p.add_argument("--cap", type=float, default=CAP_SECONDS,
+                   help="tier-1 hard timeout in seconds (default 870)")
+    p.add_argument("--threshold", type=float, default=THRESHOLD,
+                   help="fail when projection exceeds this fraction of "
+                   "the cap (default 0.85)")
+    p.add_argument("--tests-dir", default="tests")
+    p.add_argument("--audit-only", action="store_true",
+                   help="run only the marker audit (no log needed)")
+    p.add_argument("--budget-only", action="store_true",
+                   help="run only the wall-time guard")
+    args = p.parse_args(argv)
+
+    problems: List[str] = []
+    if not args.audit_only:
+        problems += check_budget(Path(args.log), args.cap, args.threshold)
+    if not args.budget_only:
+        problems += check_markers(Path(args.tests_dir))
+    for msg in problems:
+        print(f"FAIL: {msg}", file=sys.stderr)
+    if not problems:
+        print("tier-1 budget + marker audit: OK")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
