@@ -208,7 +208,7 @@ class BatchStatsNorm(nn.Module):
         # formulation.  Read at TRACE time: flipping it after a jitted
         # program compiled has no effect on that program — set it before
         # the first forward (fresh process), like BLADES_TPU_NO_PALLAS.
-        hand_vjp = os.environ.get("BLADES_TPU_BN_VJP", "1") != "0"
+        hand_vjp = os.environ.get("BLADES_TPU_BN_VJP", "1") != "0"  # blades-lint: disable=jit-purity — documented fresh-process escape hatch, trace-time by contract (see comment above)
         if scale is not None and bias is not None and hand_vjp:
             return _bn_apply(x, scale.astype(x.dtype),
                              bias.astype(x.dtype), self.epsilon)

@@ -430,7 +430,7 @@ def _mxu_mode_from_env() -> Tuple[bool, bool]:
     :func:`fused_finish_compact` wrapper."""
     import os
 
-    mode = os.environ.get("BLADES_TPU_MXU_FINISH", "")
+    mode = os.environ.get("BLADES_TPU_MXU_FINISH", "")  # blades-lint: disable=jit-purity — read per call by the un-jitted dispatch wrapper, never traced (the r5 fix)
     return mode in ("counts", "all"), mode == "all"
 
 

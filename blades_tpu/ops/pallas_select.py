@@ -44,7 +44,7 @@ def kernel_applicable(n: int, d: int) -> bool:
     ~16 MiB budget), and big enough that a single-pass kernel beats the
     fused-but-multi-pass XLA sort.  ``BLADES_TPU_NO_PALLAS=1`` (read per
     call) is the escape hatch forcing the jnp paths."""
-    if bool(int(os.environ.get("BLADES_TPU_NO_PALLAS", "0"))):
+    if bool(int(os.environ.get("BLADES_TPU_NO_PALLAS", "0"))):  # blades-lint: disable=jit-purity — documented fresh-process escape hatch, resolved at trace time by contract (docstring)
         return False
     try:
         backend = jax.default_backend()

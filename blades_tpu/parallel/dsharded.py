@@ -484,7 +484,7 @@ def elision_client_order(n: int, f: int, n_dev: int):
         extra = 1 if k < r else 0
         order += [next(mal) for _ in range(fl + extra)]
         order += [next(ben) for _ in range(nl - fl - extra)]
-    return np.asarray(order)  # host-sync: ok — setup-time layout helper, never inside a round
+    return np.asarray(order)  # blades-lint: disable=host-sync — setup-time layout helper, never inside a round
 
 
 def _validated(step, n_dev: int, f_local: int) -> Callable:
@@ -503,7 +503,7 @@ def _validated(step, n_dev: int, f_local: int) -> Callable:
             # Only the ELIDED prefix must be all-malicious — a benign
             # lane there would silently lose its training.  Malicious
             # lanes in the tail are fine (they train, then get forged).
-            m = np.asarray(malicious).reshape(n_dev, -1)  # host-sync: ok — once per mask object (same contract as streamed.py)
+            m = np.asarray(malicious).reshape(n_dev, -1)  # blades-lint: disable=host-sync — once per mask object (same contract as streamed.py)
             if not m[:, :f_local].all():
                 raise ValueError(
                     f"d-sharded elision promised every chip's first "

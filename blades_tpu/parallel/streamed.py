@@ -606,7 +606,7 @@ def streamed_step(
                 # relay), so the check is cached by array identity.
                 import numpy as np
 
-                mal_np = np.asarray(malicious)  # host-sync: ok — once per mask object, by design (see comment above)
+                mal_np = np.asarray(malicious)  # blades-lint: disable=host-sync — once per mask object, by design (see comment above)
                 if not (bool(mal_np[:skip_blocks * client_block].all())
                         and not bool(mal_np[malicious_prefix:].any())):
                     raise ValueError(

@@ -1,0 +1,29 @@
+"""Seeded use-after-donate violations (blades-lint fixture, never imported)."""
+from functools import partial
+
+import jax
+
+
+def assigned_form(state, x):
+    step = jax.jit(lambda s, v: (s, v), donate_argnums=(0,))
+    new_state, m = step(state, x)
+    return state.server  # BAD: read after donation (line 10)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def train(s, k):
+    return s
+
+
+def loop_form(s0, keys):
+    out = None
+    for k in keys:
+        out = train(s0, k)  # BAD: s0 donated in iteration 1, read in 2
+    return out
+
+
+def conditional_donate(state, x, fast):
+    donate = (0,) if fast else ()
+    step = jax.jit(lambda s, v: s, donate_argnums=donate)
+    _ = step(state, x)
+    return state  # BAD: state may have been donated

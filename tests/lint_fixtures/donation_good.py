@@ -1,0 +1,27 @@
+"""Donation discipline done right (blades-lint fixture, never imported)."""
+from functools import partial
+
+import jax
+
+
+def rebind_form(state, x):
+    step = jax.jit(lambda s, v: (s, v), donate_argnums=(0,))
+    state, m = step(state, x)
+    return state.server  # fine: the donated name was rebound
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def train(s, k):
+    return s
+
+
+def loop_rebind(s0, keys):
+    for k in keys:
+        s0 = train(s0, k)  # fine: rebound every iteration
+    return s0
+
+
+def no_donation(state, x):
+    step = jax.jit(lambda s, v: s)
+    _ = step(state, x)
+    return state  # fine: nothing was donated

@@ -1,0 +1,18 @@
+"""Seeded host-sync violations (blades-lint fixture, never imported).
+
+Scanned only when the test instantiates HostSyncPass with this path in
+its module list (the real pass scans the DEVICE_SIDE round modules).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def leaky_round(state, updates):
+    agg = jnp.mean(updates, axis=0)
+    norm = float(jnp.linalg.norm(agg))  # BAD: device sync per round
+    host = np.asarray(updates)  # BAD: numpy conversion
+    scalar = updates.sum().item()  # BAD: .item()
+    fetched = jax.device_get(agg)  # BAD: explicit fetch
+    agg.block_until_ready()  # BAD: queue drain
+    return agg, norm, host, scalar, fetched

@@ -1,0 +1,10 @@
+"""Device-resident round code (blades-lint fixture, never imported)."""
+import jax.numpy as jnp
+
+
+def clean_round(state, updates, lengths):
+    agg = jnp.mean(updates, axis=0)
+    arr = jnp.asarray(lengths)  # device op, not a host sync
+    k = int(0.2 * updates.shape[0])  # python scalars: fine
+    scale = float(2 ** 3 - 1)
+    return agg * scale, arr, k

@@ -1,0 +1,10 @@
+"""Malformed pragmas (blades-lint fixture, never imported)."""
+import numpy as np
+
+
+def bare_pragma(updates):
+    return np.asarray(updates)  # blades-lint: disable=host-sync
+
+
+def typod_pragma(updates):
+    return np.asarray(updates)  # blades-lint: disable=host-sink — the pass name is misspelled

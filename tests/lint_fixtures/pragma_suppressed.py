@@ -1,0 +1,10 @@
+"""Violations silenced by well-formed pragmas (blades-lint fixture)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def sanctioned_sync(updates):
+    mal = np.asarray(updates)  # blades-lint: disable=host-sync — fixture: once-per-mask-object fetch, sanctioned by design
+    fetched = jax.device_get(updates)  # blades-lint: disable=all — fixture: everything sanctioned on this line
+    return mal, fetched, jnp.mean(updates)

@@ -1,0 +1,25 @@
+"""Seeded PRNG-reuse violations (blades-lint fixture, never imported)."""
+import jax
+
+
+def double_consume(key, shape):
+    a = jax.random.normal(key, shape)
+    b = jax.random.uniform(key, shape)  # BAD: same key, second draw
+    return a + b
+
+
+def loop_invariant(key, n):
+    total = 0.0
+    for _ in range(n):
+        total = total + jax.random.normal(key, ())  # BAD: invariant key
+    return total
+
+
+def dropout_reuse(key, x):
+    y = keyed_dropout(key, x, 0.5)
+    z = keyed_dropout(key, x, 0.5)  # BAD: identical dropout masks
+    return y + z
+
+
+def keyed_dropout(k, x, rate):
+    return x

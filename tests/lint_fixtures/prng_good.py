@@ -1,0 +1,31 @@
+"""Key discipline done right (blades-lint fixture, never imported)."""
+import jax
+
+
+def split_between(key, shape):
+    k1, k2 = jax.random.split(key)
+    a = jax.random.normal(k1, shape)
+    b = jax.random.uniform(k2, shape)
+    return a + b
+
+
+def resplit_contract(key):
+    # Deriving twice from one key (the step/step_prebatched re-split
+    # contract) is NOT consumption.
+    k_sample = jax.random.split(key, 5)[0]
+    k_again = jax.random.split(key, 5)[0]
+    return k_sample, k_again
+
+
+def loop_folded(key, n):
+    total = 0.0
+    for i in range(n):
+        total = total + jax.random.normal(jax.random.fold_in(key, i), ())
+    return total
+
+
+def branch_exclusive(key, flag, shape):
+    if flag:
+        return jax.random.normal(key, shape)
+    else:
+        return jax.random.uniform(key, shape)  # exclusive: fine

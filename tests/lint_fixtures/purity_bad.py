@@ -1,0 +1,31 @@
+"""Seeded impure-jit-body violations (blades-lint fixture, never imported)."""
+import os
+
+import jax
+
+_MODE = {"value": 0}
+
+
+@jax.jit
+def env_in_jit(x):
+    mode = os.environ.get("BLADES_TPU_FIXTURE_MODE", "fast")  # BAD
+    return x if mode == "fast" else -x
+
+
+def helper(x):
+    print("tracing", x)  # BAD: reachable from body_jit
+    return x * 2
+
+
+def body_jit(x):
+    return helper(x)
+
+
+def mutating_body(c, x):
+    global _MODE  # BAD: trace-time mutation
+    _MODE["value"] += 1
+    return c + x
+
+
+def build(fn=mutating_body):
+    return jax.jit(mutating_body)

@@ -1,0 +1,34 @@
+"""Host state resolved outside the trace (blades-lint fixture)."""
+import os
+
+import jax
+
+
+def resolve_mode():
+    # Host read in an UN-jitted wrapper; the result is passed in as a
+    # static value — the r5 pallas_round pattern.
+    return os.environ.get("BLADES_TPU_FIXTURE_MODE", "fast") == "fast"
+
+
+def dispatch(x):
+    fast = resolve_mode()
+    return _step(x, fast)
+
+
+@jax.jit
+def _step(x, fast):
+    return x if fast else -x
+
+
+def host_logger(x):
+    print("not traced anywhere", x)  # fine: unreachable from jit
+    return x
+
+
+@jax.jit
+def outer_with_host_closure(x):
+    def debug_dump(v):  # never referenced: NOT traced with outer
+        print("host-only helper", v)
+
+    del debug_dump
+    return x * 2

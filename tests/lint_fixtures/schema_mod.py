@@ -1,0 +1,7 @@
+"""Mini obs-schema module (blades-lint fixture, never imported)."""
+
+ROUND_RECORD_FIELDS = {
+    "train_loss": ((int, float), True),
+    "test_acc": ((int, float), False),
+    "never_stamped": ((int,), False),  # -> registered-but-unstamped WARNING
+}

@@ -1,0 +1,9 @@
+"""Round-record stamps with one unregistered key (blades-lint fixture)."""
+
+
+def fill_round_metrics(row, metrics):
+    row["train_loss"] = metrics["train_loss"]
+    row["mystery_key"] = 1.0  # BAD: not in ROUND_RECORD_FIELDS
+    for k in ("test_acc",):
+        row[k] = metrics[k]
+    return row

@@ -1,0 +1,12 @@
+"""Round-record stamps fully covered by the mini schema (fixture)."""
+
+
+def fill_round_metrics(row, metrics):
+    row["train_loss"] = metrics["train_loss"]
+    row.update({"test_acc": metrics["test_acc"]})
+    return row
+
+
+def never_stamped_consumer(row):
+    # Loads don't count as stamps: reading a key is always safe.
+    return row["never_stamped"]

@@ -1,0 +1,20 @@
+"""Seeded static-config violations (blades-lint fixture, never imported)."""
+import dataclasses
+from typing import Dict, List, Optional
+
+
+@dataclasses.dataclass
+class UnfrozenConfig:  # BAD: mutable jit cache key
+    rate: float = 0.0
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class IdentityHashConfig:  # BAD: eq=False splits the jit cache
+    rate: float = 0.0
+
+
+@dataclasses.dataclass(frozen=True)
+class UnhashableFieldsConfig:
+    schedule: List[int] = ()  # BAD: unhashable annotation
+    table: Optional[Dict[str, int]] = None  # BAD: dict inside Optional
+    hooks: list = dataclasses.field(default_factory=list)  # BAD: twice

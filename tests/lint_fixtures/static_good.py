@@ -1,0 +1,17 @@
+"""A well-formed static jit-arg config (blades-lint fixture)."""
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class WellFormedConfig:
+    rate: float = 0.0
+    schedule: Tuple[Tuple[int, float], ...] = ()
+    label: Optional[str] = None
+
+
+class NotADataclassConfig:
+    """Builder-style configs are out of this pass's scope."""
+
+    def __init__(self):
+        self.values = {}

@@ -1,0 +1,353 @@
+"""blades-lint (tools/lint): the tier-1 static-analysis gate.
+
+Three layers:
+
+1. **Fixture coverage** — every pass has a known-bad / known-good pair
+   under ``tests/lint_fixtures/`` (deliberately-seeded violations of
+   each invariant: donation reuse, key reuse, env-read-in-jit, host
+   sync, unfrozen static config, unregistered metric key, unmarked mesh
+   test, stale artifact stamp), pragma-suppression behavior, and the
+   ``--changed`` file filter.
+2. **CLI contract** — ``python -m tools.lint --json`` emits the
+   machine-readable findings the sweep/bench harnesses consume.
+3. **CI enforcement** — every pass over THIS repo's full tree must
+   report zero unsuppressed error findings (the test that makes lint
+   regressions tier-1 failures), inside the lint wall-time budget.
+"""
+
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+from tools.lint import ERROR, run_passes  # noqa: E402
+from tools.lint.cli import main as lint_main  # noqa: E402
+from tools.lint import core  # noqa: E402
+from tools.lint.core import changed_files, collect_files  # noqa: E402
+from tools.lint.passes import ALL_PASSES  # noqa: E402
+from tools.lint.passes.artifacts import (  # noqa: E402
+    ArtifactStampsPass,
+    recompute_stamps,
+)
+from tools.lint.passes.donation import DonationPass  # noqa: E402
+from tools.lint.passes.host_sync import HostSyncPass  # noqa: E402
+from tools.lint.passes.prng import PrngPass  # noqa: E402
+from tools.lint.passes.purity import PurityPass  # noqa: E402
+from tools.lint.passes.schema_drift import SchemaDriftPass  # noqa: E402
+from tools.lint.passes.slow_markers import audit_path  # noqa: E402
+from tools.lint.passes.static_args import StaticArgsPass  # noqa: E402
+from tools.lint.core import LintContext  # noqa: E402
+
+FIX = "tests/lint_fixtures"
+
+
+def run_fixture(passes, *names):
+    """Run `passes` over the named fixture files only."""
+    only = [REPO / FIX / n for n in names]
+    return run_passes(REPO, passes, only=only)
+
+
+def errors_of(findings, pass_name=None):
+    return [f for f in findings if f.severity == ERROR
+            and (pass_name is None or f.pass_name == pass_name)]
+
+
+# ---------------------------------------------------------------------------
+# per-pass fixture pairs (seeded violations must be caught; clean twins
+# must stay clean)
+# ---------------------------------------------------------------------------
+
+
+def test_donation_fixtures():
+    bad = errors_of(run_fixture([DonationPass()], "donation_bad.py"),
+                    "use-after-donate")
+    msgs = "\n".join(f.message for f in bad)
+    assert "'state' is read after being donated" in msgs
+    assert "'s0' is read after being donated" in msgs  # the loop form
+    assert "'state' is read after being donated to step()" in msgs
+    assert len(bad) >= 3
+    assert run_fixture([DonationPass()], "donation_good.py") == []
+
+
+def test_prng_fixtures():
+    bad = errors_of(run_fixture([PrngPass()], "prng_bad.py"), "prng-reuse")
+    msgs = "\n".join(f.message for f in bad)
+    assert "key 'key' already consumed" in msgs
+    assert "loop-invariant key 'key'" in msgs
+    assert sum("dropout" not in m for m in [f.message for f in bad]) >= 2
+    assert len(bad) == 3  # double draw, loop invariant, dropout reuse
+    assert run_fixture([PrngPass()], "prng_good.py") == []
+
+
+def test_purity_fixtures():
+    bad = errors_of(run_fixture([PurityPass()], "purity_bad.py"),
+                    "jit-purity")
+    msgs = "\n".join(f.message for f in bad)
+    assert "`os.environ.get` read inside `env_in_jit`" in msgs
+    assert "`print()` call inside `helper`" in msgs  # via _jit reachability
+    assert "`global` statement" in msgs  # via jax.jit(mutating_body)
+    assert run_fixture([PurityPass()], "purity_good.py") == []
+
+
+def test_host_sync_fixtures():
+    hs = HostSyncPass(modules=[f"{FIX}/hostsync_bad.py"])
+    bad = errors_of(run_fixture([hs], "hostsync_bad.py"), "host-sync")
+    msgs = "\n".join(f.message for f in bad)
+    assert "float() on an array expression" in msgs
+    assert "np.asarray()" in msgs
+    assert ".item()" in msgs
+    assert "jax.device_get()" in msgs
+    assert ".block_until_ready()" in msgs
+    assert len(bad) == 5
+    hs_good = HostSyncPass(modules=[f"{FIX}/hostsync_good.py"])
+    assert run_fixture([hs_good], "hostsync_good.py") == []
+
+
+def test_static_args_fixtures():
+    sa = StaticArgsPass(prefixes=[f"{FIX}/static_bad.py"])
+    bad = errors_of(run_fixture([sa], "static_bad.py"), "static-config")
+    msgs = "\n".join(f.message for f in bad)
+    assert "UnfrozenConfig is not frozen=True" in msgs
+    assert "IdentityHashConfig sets eq=False" in msgs
+    assert "UnhashableFieldsConfig.schedule" in msgs
+    assert "UnhashableFieldsConfig.table" in msgs  # dict inside Optional
+    assert "defaults to a mutable list()" in msgs
+    sa_good = StaticArgsPass(prefixes=[f"{FIX}/static_good.py"])
+    assert run_fixture([sa_good], "static_good.py") == []
+
+
+def test_schema_drift_fixtures():
+    sd = SchemaDriftPass(schema_module=f"{FIX}/schema_mod.py",
+                         stamp_modules=[f"{FIX}/schema_stamp_bad.py"])
+    findings = run_fixture([sd], "schema_mod.py", "schema_stamp_bad.py")
+    bad = errors_of(findings, "schema-drift")
+    assert len(bad) == 1 and "mystery_key" in bad[0].message
+    warns = [f for f in findings if f.severity != ERROR]
+    assert len(warns) == 1 and "never_stamped" in warns[0].message
+    # The clean twin: every stamp registered; only the warning remains.
+    sd_good = SchemaDriftPass(schema_module=f"{FIX}/schema_mod.py",
+                              stamp_modules=[f"{FIX}/schema_stamp_good.py"])
+    findings = run_fixture([sd_good], "schema_mod.py",
+                           "schema_stamp_good.py")
+    assert errors_of(findings) == []
+    assert any("never_stamped" in f.message for f in findings)
+
+
+def test_slow_markers_fixture(tmp_path):
+    bad = tmp_path / "probe.py"
+    bad.write_text(
+        "import pytest\n"
+        "from blades_tpu.parallel import make_mesh\n\n"
+        "@pytest.fixture\n"
+        "def setup():\n"
+        "    return make_mesh()\n\n"
+        "def test_uses_fixture(setup):\n"
+        "    pass\n\n"
+        "@pytest.mark.slow\n"
+        "def test_marked():\n"
+        "    make_mesh()\n"
+    )
+    findings = audit_path(bad)
+    assert len(findings) == 1
+    assert "test_uses_fixture" in findings[0].message
+    assert "fixture 'setup'" in findings[0].message
+
+
+def test_artifact_stamps_fixture(tmp_path):
+    # A miniature repo: the reference-grid constants + one stale artifact.
+    curves = tmp_path / "blades_tpu" / "benchmarks"
+    curves.mkdir(parents=True)
+    (curves / "accuracy_curves.py").write_text(
+        'REFERENCE_AGGREGATORS = ["Mean", "Median"]\n'
+        "REFERENCE_MALICIOUS_FRACS = [0.0, 0.5]\n")
+    art = tmp_path / "artifacts" / "smoke"
+    art.mkdir(parents=True)
+    rows = [{"aggregator": "Mean", "num_malicious": 0}]
+    (art / "curves.json").write_text(json.dumps(
+        {"num_clients": 10, "complete": True, "rows": rows}))
+    findings = list(ArtifactStampsPass().run(LintContext(tmp_path, [])))
+    assert len(findings) == 1 and "stale complete: True" in findings[0].message
+    # Re-stamped under reference-grid semantics the artifact is accepted.
+    data = json.loads((art / "curves.json").read_text())
+    data.update(recompute_stamps(data, ["Mean", "Median"], [0.0, 0.5]))
+    assert data["complete"] is False
+    assert data["reference_cells_missing"] == ["Mean@5", "Median@0",
+                                               "Median@5"]
+    (art / "curves.json").write_text(json.dumps(data))
+    assert list(ArtifactStampsPass().run(LintContext(tmp_path, []))) == []
+
+
+def test_restamp_curves_cli(tmp_path):
+    """The fixer round-trips: --check flags, a rewrite silences."""
+    stale = tmp_path / "curves.json"
+    stale.write_text(json.dumps({
+        "num_clients": 60, "complete": True,
+        "rows": [{"aggregator": "Mean", "num_malicious": 0}]}))
+    cmd = [sys.executable, str(REPO / "tools" / "restamp_curves.py")]
+    r = subprocess.run(cmd + ["--check", str(stale)],
+                       capture_output=True, text=True, cwd=str(REPO))
+    assert r.returncode == 1 and "True -> False" in r.stdout
+    r = subprocess.run(cmd + [str(stale)],
+                       capture_output=True, text=True, cwd=str(REPO))
+    assert r.returncode == 0, r.stderr
+    data = json.loads(stale.read_text())
+    assert data["complete"] is False and data["reference_cells_missing"]
+    r = subprocess.run(cmd + ["--check", str(stale)],
+                       capture_output=True, text=True, cwd=str(REPO))
+    assert r.returncode == 0  # stamps now current
+
+
+# ---------------------------------------------------------------------------
+# pragma allowlist
+# ---------------------------------------------------------------------------
+
+
+def test_pragma_suppresses_with_reason():
+    hs = HostSyncPass(modules=[f"{FIX}/pragma_suppressed.py"])
+    findings = run_fixture([hs], "pragma_suppressed.py")
+    # Both violations suppressed (named pass + `all`), pragmas carry
+    # reasons, so nothing at all is reported.
+    assert findings == []
+
+
+def test_pragma_requires_reason_and_real_pass_name():
+    hs = HostSyncPass(modules=[f"{FIX}/pragma_bad.py"])
+    findings = run_fixture([hs], "pragma_bad.py")
+    pragma = [f for f in findings if f.pass_name == "pragma"]
+    assert any("without a justification" in f.message for f in pragma)
+    assert any("unknown pass(es) ['host-sink']" in f.message
+               for f in pragma)
+    # The bare-but-parsed pragma still suppresses its line; the typo'd
+    # one suppresses nothing, so its host-sync violation survives.
+    hs_findings = errors_of(findings, "host-sync")
+    assert len(hs_findings) == 1 and hs_findings[0].line == 10
+
+
+def test_pragma_in_string_is_not_live(tmp_path):
+    # A pragma spelled inside a docstring/string (e.g. a module
+    # documenting the grammar) must register nothing — neither a
+    # suppression nor a pragma-audit finding.
+    f = tmp_path / "docstrings.py"
+    f.write_text(
+        '"""Grammar doc:\n'
+        "``# blades-lint: disable-file=host-sync — example``\n"
+        '"""\n'
+        'S = "# blades-lint: disable=all — in a string"\n'
+        "x = 1  # blades-lint: disable=host-sync — a REAL comment pragma\n"
+    )
+    sf = core.SourceFile(f, tmp_path)
+    assert len(sf.pragmas) == 1 and sf.pragmas[0].line == 5
+    assert not sf.disabled("host-sync", 2)
+
+
+# ---------------------------------------------------------------------------
+# --changed filtering + CLI
+# ---------------------------------------------------------------------------
+
+
+def test_changed_file_filtering(tmp_path):
+    def git(*args):
+        subprocess.run(["git", "-c", "user.email=t@t", "-c", "user.name=t",
+                        *args], cwd=tmp_path, check=True,
+                       capture_output=True)
+
+    git("init", "-q")
+    committed = tmp_path / "committed.py"
+    committed.write_text("import jax\n\ndef f(key):\n"
+                         "    a = jax.random.normal(key, ())\n"
+                         "    return a + jax.random.normal(key, ())\n")
+    git("add", "committed.py")
+    git("commit", "-qm", "seed")
+    fresh = tmp_path / "fresh.py"
+    fresh.write_text(committed.read_text())
+    changed = changed_files(tmp_path)
+    assert changed == [fresh]
+    # Only the changed file is linted: committed.py's identical
+    # violation stays invisible to a --changed run.
+    findings = run_passes(tmp_path, [PrngPass()], only=changed)
+    assert {f.path for f in findings} == {"fresh.py"}
+    assert errors_of(findings, "prng-reuse")
+
+
+def test_cli_json_machine_readable():
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.lint", "--json",
+         f"{FIX}/prng_bad.py", f"{FIX}/donation_bad.py"],
+        capture_output=True, text=True, cwd=str(REPO))
+    assert r.returncode == 1
+    payload = json.loads(r.stdout)
+    assert payload["summary"]["errors"] >= 4
+    by_pass = {f["pass_name"] for f in payload["findings"]}
+    assert {"prng-reuse", "use-after-donate"} <= by_pass
+    sample = payload["findings"][0]
+    assert {"pass_name", "path", "line", "message", "fix_hint",
+            "severity"} <= set(sample)
+
+
+def test_cli_lists_all_passes():
+    r = subprocess.run([sys.executable, "-m", "tools.lint",
+                        "--list-passes"],
+                       capture_output=True, text=True, cwd=str(REPO))
+    assert r.returncode == 0
+    names = [line.split()[0] for line in r.stdout.splitlines() if line]
+    assert len(names) >= 7  # ISSUE 8: at least 6 passes + the folded audit
+    for expected in ("use-after-donate", "prng-reuse", "jit-purity",
+                     "host-sync", "static-config", "schema-drift",
+                     "slow-markers", "artifact-stamps"):
+        assert expected in names
+
+
+# ---------------------------------------------------------------------------
+# CI enforcement: the real tree is clean, inside the wall-time budget
+# ---------------------------------------------------------------------------
+
+
+def test_repo_tree_is_clean():
+    """Every pass over blades_tpu/, bench.py, tests/ and tools/: zero
+    unsuppressed ERROR findings — new violations land as tier-1
+    failures with file:line + fix-hint."""
+    t0 = time.perf_counter()
+    findings = run_passes(REPO, ALL_PASSES)
+    elapsed = time.perf_counter() - t0
+    bad = errors_of(findings)
+    assert not bad, "\n" + "\n".join(f.render() for f in bad)
+    # Warnings must stay actionable, not accumulate as noise: the
+    # dynamically-stamped schema keys are pragma'd, so a clean tree
+    # reports NO warnings either.
+    assert findings == [], "\n".join(f.render() for f in findings)
+    # ISSUE 8 budget: the full-tree lint stays well under 60 s so it
+    # rides tier-1 without denting the 870 s cap.
+    assert elapsed < 60.0, f"lint took {elapsed:.1f}s"
+
+
+def test_fixture_dir_is_excluded_from_tree_scan():
+    files = {f.rel for f in collect_files(REPO)}
+    assert not any("lint_fixtures" in rel for rel in files)
+    assert "blades_tpu/core/round.py" in files
+    assert "bench.py" in files
+    assert "tools/lint/core.py" in files
+
+
+@pytest.mark.parametrize("seeded", [
+    "donation_bad.py", "prng_bad.py", "purity_bad.py", "hostsync_bad.py",
+    "static_bad.py", "schema_stamp_bad.py"])
+def test_every_seeded_violation_class_is_caught(seeded):
+    """ISSUE 8 acceptance: donation reuse, key reuse, env-read-in-jit,
+    host sync, unfrozen static config, unregistered metric key — each
+    deliberately-seeded class is caught by its pass."""
+    passes = [
+        DonationPass(), PrngPass(), PurityPass(),
+        HostSyncPass(modules=[f"{FIX}/hostsync_bad.py"]),
+        StaticArgsPass(prefixes=[f"{FIX}/static_bad.py"]),
+        SchemaDriftPass(schema_module=f"{FIX}/schema_mod.py",
+                        stamp_modules=[f"{FIX}/schema_stamp_bad.py"]),
+    ]
+    extra = (["schema_mod.py"] if seeded == "schema_stamp_bad.py" else [])
+    findings = run_fixture(passes, seeded, *extra)
+    assert errors_of(findings), f"no pass caught {seeded}"

@@ -85,6 +85,14 @@ def _multiprocess_capability() -> str:
     port = _free_port()
     coord = f"127.0.0.1:{port}"
     env = _worker_env()
+    # The probe must answer FAST on relay-dead boxes: without this, a
+    # half-present TPU plugin retries GCE metadata fetches for ~30 s per
+    # tpu-env variable (~90 s total, measured — the single biggest line
+    # in the tier-1 budget) before the coordinator process even starts.
+    # Skipping the metadata query does not change the verdict here:
+    # locally-discovered chips still initialize, and the CPU fallback
+    # fails the collective with the same capability error in ~4 s.
+    env["TPU_SKIP_MDS_QUERY"] = "1"
     procs = [_spawn(["-c", _PROBE, coord, str(i)], env) for i in range(2)]
     outs = []
     try:

@@ -20,7 +20,10 @@ Two checks, both runnable from CI and exercised by ``tests/test_tools.py``:
    marker (module ``pytestmark``, decorator, or the fixture itself being
    used only by marked tests).  Mesh compiles are the single most
    expensive test class on this box; an unmarked one silently eats the
-   tier-1 budget.
+   tier-1 budget.  Since ISSUE 8 the audit IMPLEMENTATION lives in the
+   blades-lint framework (``tools/lint/passes/slow_markers.py``, the
+   ``slow-markers`` pass) so all static analysis runs through one
+   visitor core; this CLI keeps its historical surface and delegates.
 
 Exit code 0 = all checks pass; 1 = violation; 2 = usage/parse error.
 
@@ -33,15 +36,21 @@ Usage::
 from __future__ import annotations
 
 import argparse
-import ast
 import re
 import sys
 from pathlib import Path
-from typing import Dict, List, Optional, Tuple
+from typing import List, Optional, Tuple
+
+# Importable both as `tools.check_tier1_budget` and as a top-level
+# module with tools/ on sys.path (the historical test harness does the
+# latter); either way the lint package needs the repo root.
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from tools.lint.passes import slow_markers as _slow  # noqa: E402
 
 CAP_SECONDS = 870.0
 THRESHOLD = 0.85
-MESH_CALLS = {"make_mesh", "shard_federation"}
+MESH_CALLS = _slow.MESH_CALLS
 
 _DURATION_RE = re.compile(
     r"^\s*(\d+(?:\.\d+)?)s\s+(call|setup|teardown)\s+(\S+)"
@@ -118,82 +127,21 @@ def check_budget(log_path: Path, cap: float, threshold: float) -> List[str]:
 
 
 # ---------------------------------------------------------------------------
-# marker audit
+# marker audit (delegates to the blades-lint slow-markers pass)
 # ---------------------------------------------------------------------------
-
-
-def _has_slow_mark(deco_list) -> bool:
-    for d in deco_list:
-        for node in ast.walk(d):
-            if isinstance(node, ast.Attribute) and node.attr == "slow":
-                return True
-    return False
-
-
-def _is_fixture(deco_list) -> bool:
-    for d in deco_list:
-        for node in ast.walk(d):
-            if isinstance(node, ast.Attribute) and node.attr == "fixture":
-                return True
-            if isinstance(node, ast.Name) and node.id == "fixture":
-                return True
-    return False
-
-
-def _module_slow(tree: ast.Module) -> bool:
-    """``pytestmark = pytest.mark.slow`` (or a list containing it)."""
-    for node in tree.body:
-        if isinstance(node, ast.Assign) and any(
-            isinstance(t, ast.Name) and t.id == "pytestmark"
-            for t in node.targets
-        ):
-            for sub in ast.walk(node.value):
-                if isinstance(sub, ast.Attribute) and sub.attr == "slow":
-                    return True
-    return False
-
-
-def _calls_mesh(fn: ast.AST) -> bool:
-    for node in ast.walk(fn):
-        if isinstance(node, ast.Call):
-            f = node.func
-            name = f.id if isinstance(f, ast.Name) else (
-                f.attr if isinstance(f, ast.Attribute) else None)
-            if name in MESH_CALLS:
-                return True
-    return False
 
 
 def audit_file(path: Path) -> List[str]:
     """Unmarked mesh tests in one file (violation messages)."""
-    try:
-        tree = ast.parse(path.read_text(), filename=str(path))
-    except SyntaxError as exc:
-        return [f"{path}: unparseable ({exc})"]
-    if _module_slow(tree):
-        return []
-    mesh_fixtures = set()
-    functions = [n for n in ast.walk(tree)
-                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
-    for fn in functions:
-        if _is_fixture(fn.decorator_list) and _calls_mesh(fn):
-            mesh_fixtures.add(fn.name)
-    violations = []
-    for fn in functions:
-        if not fn.name.startswith("test"):
-            continue
-        if _has_slow_mark(fn.decorator_list):
-            continue
-        args = {a.arg for a in fn.args.args}
-        uses_mesh = _calls_mesh(fn) or (args & mesh_fixtures)
-        if uses_mesh:
-            via = (f"fixture {sorted(args & mesh_fixtures)[0]!r}"
-                   if args & mesh_fixtures else "direct mesh call")
-            violations.append(
-                f"{path.name}::{fn.name}: builds the 8-device mesh "
-                f"({via}) without @pytest.mark.slow"
-            )
-    return violations
+    out = []
+    for f in _slow.audit_path(path):
+        if "unparseable" in f.message:
+            out.append(f"{path}: {f.message}")
+        else:
+            # Historical message shape: "<file>::<test>: builds the ..."
+            test_name, rest = f.message.split(" ", 1)
+            out.append(f"{path.name}::{test_name}: {rest}")
+    return out
 
 
 def check_markers(tests_dir: Path) -> List[str]:

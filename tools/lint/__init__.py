@@ -1,0 +1,29 @@
+"""blades-lint: JAX-aware static analysis for the load-bearing invariants.
+
+The pure-functional analogue of a race detector: instead of data races,
+the bug classes here are broken purity contracts — use-after-donate,
+PRNG key reuse, host effects traced into jit bodies, host syncs in the
+round pipeline, unhashable static jit args, metric-schema drift, stale
+artifact stamps, and unmarked mesh tests.
+
+CLI::
+
+    python -m tools.lint              # full tree, human-readable
+    python -m tools.lint --changed    # only files changed vs HEAD
+    python -m tools.lint --json       # machine-readable findings
+
+Tier-1 enforcement: ``tests/test_lint.py`` runs every pass over the
+tree and fails on new ERROR findings.  Suppression:
+``# blades-lint: disable=<pass> — <reason>`` (see tools/lint/core.py).
+"""
+
+from tools.lint.core import (  # noqa: F401
+    ERROR,
+    WARNING,
+    Finding,
+    LintContext,
+    LintPass,
+    SourceFile,
+    collect_files,
+    run_passes,
+)

@@ -1,0 +1,129 @@
+"""Small shared AST helpers for the blades-lint passes."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Tuple
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` as a string for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    """The dotted callee of a Call, else None (lambdas, subscripts...)."""
+    return dotted(call.func)
+
+
+def walk_calls(node: ast.AST) -> Iterator[ast.Call]:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            yield sub
+
+
+def literal_int_tuple(node: ast.AST) -> Optional[Tuple[int, ...]]:
+    """``(0, 1)`` / ``0`` / ``()`` as a tuple of ints, else None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for el in node.elts:
+            if not (isinstance(el, ast.Constant)
+                    and isinstance(el.value, int)
+                    and not isinstance(el.value, bool)):
+                return None
+            out.append(el.value)
+        return tuple(out)
+    return None
+
+
+def assign_target_paths(stmt: ast.stmt) -> List[str]:
+    """Every dotted path a statement (re)binds: plain/tuple/starred
+    assignment targets, aug/ann-assign, for-targets, with-as, del."""
+    targets: List[ast.AST] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        targets = [stmt.target]
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        targets = [i.optional_vars for i in stmt.items
+                   if i.optional_vars is not None]
+    elif isinstance(stmt, ast.Delete):
+        targets = list(stmt.targets)
+    out: List[str] = []
+
+    def flatten(t: ast.AST) -> None:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for el in t.elts:
+                flatten(el)
+        elif isinstance(t, ast.Starred):
+            flatten(t.value)
+        else:
+            d = dotted(t)
+            if d is not None:
+                out.append(d)
+
+    for t in targets:
+        flatten(t)
+    # Walrus targets anywhere in the statement rebind too.
+    for sub in ast.walk(stmt):
+        if isinstance(sub, ast.NamedExpr):
+            d = dotted(sub.target)
+            if d is not None:
+                out.append(d)
+    return out
+
+
+_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+                ast.Lambda)
+
+
+def scope_nodes(scope: ast.AST, prune=_SCOPE_NODES) -> List[ast.AST]:
+    """Descendants of ``scope`` that are not inside a nested scope of a
+    pruned kind (``ast.walk`` cannot prune, so passes that must not
+    attribute a nested def's contents to its parent use this)."""
+    out: List[ast.AST] = []
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, prune):
+            continue
+        out.append(node)
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def function_defs(tree: ast.AST) -> List[ast.FunctionDef]:
+    return [n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+
+def decorator_names(fn: ast.AST) -> List[str]:
+    """Dotted names of decorators, looking through Call decorators into
+    both the callee and its arguments (``@partial(jax.jit, ...)`` yields
+    ``partial`` and ``jax.jit``)."""
+    names: List[str] = []
+    for d in getattr(fn, "decorator_list", []):
+        n = dotted(d)
+        if n:
+            names.append(n)
+        if isinstance(d, ast.Call):
+            n = dotted(d.func)
+            if n:
+                names.append(n)
+            for a in d.args:
+                n = dotted(a)
+                if n:
+                    names.append(n)
+    return names

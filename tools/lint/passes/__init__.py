@@ -1,0 +1,28 @@
+"""blades-lint pass registry.
+
+Adding a pass: subclass :class:`tools.lint.core.LintPass` in a module
+here, set ``name`` (the pragma token) and ``doc``, implement ``run``,
+and append an instance to :data:`ALL_PASSES`.  Fixture coverage in
+``tests/test_lint.py`` (a known-bad + known-good pair under
+``tests/lint_fixtures/``) is part of the definition of done.
+"""
+
+from tools.lint.passes.artifacts import ArtifactStampsPass
+from tools.lint.passes.donation import DonationPass
+from tools.lint.passes.host_sync import HostSyncPass
+from tools.lint.passes.prng import PrngPass
+from tools.lint.passes.purity import PurityPass
+from tools.lint.passes.schema_drift import SchemaDriftPass
+from tools.lint.passes.slow_markers import SlowMarkersPass
+from tools.lint.passes.static_args import StaticArgsPass
+
+ALL_PASSES = (
+    DonationPass(),
+    PrngPass(),
+    PurityPass(),
+    HostSyncPass(),
+    StaticArgsPass(),
+    SchemaDriftPass(),
+    SlowMarkersPass(),
+    ArtifactStampsPass(),
+)

@@ -1,0 +1,276 @@
+"""use-after-donate: reads of a buffer after a donating dispatch.
+
+PR 3's contract — ``jax.jit(fn, donate_argnums=(0,))`` lets XLA reuse
+the argument's buffers in place, so the PRE-step value is invalidated
+the moment the dispatch is issued.  Reading it afterwards raises (best
+case) or reads freed memory through stale references; until this pass
+the invariant lived in a docstring.
+
+Detection is module-local and flow-insensitive-but-ordered:
+
+1. Collect every *donating callable* the module defines — a name bound
+   to ``jax.jit(f, donate_argnums=...)`` / ``cached_jit(...,
+   donate_argnums=...)`` (attribute targets like ``self._step`` count),
+   or a function decorated ``@partial(jax.jit, donate_argnums=...)``.
+   ``donate_argnums`` must resolve to literal int positions; a plain
+   name is chased through one local ``x = (0,) if cond else ()``-style
+   assignment (positions union — donation *may* happen is enough).
+2. Walk each scope's statements in order: a call to a donating callable
+   marks the dotted path at each donated position as dead; a later load
+   of that path is a finding; any rebind revives it.  Loop bodies are
+   walked twice so a donation in iteration ``i`` flags a read in
+   iteration ``i+1`` (``for ...: m = step(state)`` with no rebind).
+
+Cross-module donators (a factory returning a donating jit from another
+file) are out of scope — the factory's own module is where the call
+discipline lives, and every in-repo factory call site rebinds in the
+same statement.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.lint import astutil
+from tools.lint.core import Finding, LintContext, LintPass
+
+_JIT_FACTORIES = {"jax.jit", "jit", "cached_jit", "pjit", "jax.pjit"}
+
+
+def _resolve_argnums(node: ast.AST,
+                     scope_assigns: Dict[str, ast.AST]) -> Optional[Tuple[int, ...]]:
+    """Literal donate_argnums positions, chasing one level of local
+    assignment and conditional expressions (union of branches)."""
+    lit = astutil.literal_int_tuple(node)
+    if lit is not None:
+        return lit
+    if isinstance(node, ast.IfExp):
+        a = _resolve_argnums(node.body, scope_assigns)
+        b = _resolve_argnums(node.orelse, scope_assigns)
+        if a is None and b is None:
+            return None
+        return tuple(sorted(set(a or ()) | set(b or ())))
+    if isinstance(node, ast.Name) and node.id in scope_assigns:
+        return _resolve_argnums(scope_assigns[node.id], {})
+    return None
+
+
+def _donating_call(call: ast.Call,
+                   scope_assigns: Dict[str, ast.AST]) -> Optional[Tuple[int, ...]]:
+    """donate positions if this Call constructs a donating jit."""
+    cn = astutil.call_name(call)
+    if cn is None:
+        return None
+    if cn.split(".")[-1] not in {f.split(".")[-1] for f in _JIT_FACTORIES} \
+            and cn not in _JIT_FACTORIES:
+        return None
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            nums = _resolve_argnums(kw.value, scope_assigns)
+            if nums:
+                return nums
+    return None
+
+
+class _ScopeWalker:
+    """Ordered statement walk of one function/module body."""
+
+    def __init__(self, owner: "DonationPass", src_rel: str,
+                 donators: Dict[str, Tuple[int, ...]]):
+        self.owner = owner
+        self.rel = src_rel
+        self.donators = donators
+        self.dead: Dict[str, Tuple[int, str]] = {}  # path -> (line, callee)
+        self.findings: List[Finding] = []
+        self._seen: Set[Tuple[int, str]] = set()
+
+    def walk(self, body: List[ast.stmt]) -> None:
+        for stmt in body:
+            self._statement(stmt)
+
+    # -- one statement ------------------------------------------------------
+
+    def _statement(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # nested scopes are walked separately
+        # 1) loads of currently-dead paths (the donation call's own
+        #    arguments evaluate before the dispatch, so same-statement
+        #    loads check against the PRE-statement dead set).
+        self._check_loads(stmt)
+        # 2) donation calls kill their buffer args.  Compound statements
+        #    contribute only their HEADER here — calls in their bodies
+        #    are handled by the recursion in step 4, in body order.
+        for node in self._header_nodes(stmt):
+            self._mark_donations(node)
+        # 3) rebinds revive.
+        for path in astutil.assign_target_paths(stmt):
+            self.dead.pop(path, None)
+            # Rebinding `x` also revives `x.attr` paths.
+            stale = [p for p in self.dead if p.startswith(path + ".")]
+            for p in stale:
+                self.dead.pop(p, None)
+        # 4) recurse into compound statements, loop bodies twice (a
+        #    donation surviving iteration N is read by iteration N+1).
+        if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+            self.walk(list(stmt.body))
+            self.walk(list(stmt.body))
+            self.walk(list(stmt.orelse))
+        elif isinstance(stmt, ast.If):
+            before = dict(self.dead)
+            self.walk(list(stmt.body))
+            after_body = self.dead
+            self.dead = dict(before)
+            self.walk(list(stmt.orelse))
+            # Union: donated in either branch stays suspect afterwards.
+            self.dead.update(after_body)
+        elif isinstance(stmt, ast.Try):
+            self.walk(list(stmt.body))
+            for h in stmt.handlers:
+                self.walk(list(h.body))
+            self.walk(list(stmt.orelse))
+            self.walk(list(stmt.finalbody))
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            self.walk(list(stmt.body))
+
+    @staticmethod
+    def _header_nodes(stmt: ast.stmt) -> List[ast.AST]:
+        """The statement's own expressions, excluding nested bodies."""
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            return [stmt.iter]
+        if isinstance(stmt, ast.While) or isinstance(stmt, ast.If):
+            return [stmt.test]
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            return [i.context_expr for i in stmt.items]
+        if isinstance(stmt, ast.Try):
+            return []
+        return [stmt]
+
+    def _mark_donations(self, node: ast.AST) -> None:
+        for call in astutil.walk_calls(node):
+            nums = self.donators.get(astutil.call_name(call) or "")
+            if not nums:
+                continue
+            for pos in nums:
+                if pos < len(call.args):
+                    path = astutil.dotted(call.args[pos])
+                    if path is not None:
+                        self.dead[path] = (call.lineno,
+                                           astutil.call_name(call) or "?")
+
+    def _check_loads(self, stmt: ast.stmt) -> None:
+        if not self.dead:
+            return
+        # Compound statements: only inspect the header expression here
+        # (bodies are recursed into with updated state).
+        for node in self._header_nodes(stmt):
+            for sub in ast.walk(node):
+                path = astutil.dotted(sub)
+                if path is None or not isinstance(getattr(sub, "ctx", None),
+                                                  (ast.Load,)):
+                    continue
+                hit = self.dead.get(path)
+                if hit is None:
+                    # A load of x.y where x itself was donated dies too.
+                    for dead_path, h in self.dead.items():
+                        if path.startswith(dead_path + "."):
+                            hit = h
+                            break
+                if hit is None:
+                    continue
+                dline, callee = hit
+                key = (sub.lineno, path)
+                if key in self._seen:
+                    continue
+                self._seen.add(key)
+                self.findings.append(Finding(
+                    self.owner.name, self.rel, sub.lineno,
+                    f"'{path}' is read after being donated to {callee}() "
+                    f"at line {dline} (donate_argnums invalidates the "
+                    "buffer at dispatch)",
+                    fix_hint="rebind the result over the donated name "
+                             "(state = step(state, ...)), or drop "
+                             "donate_argnums for this dispatch"))
+
+
+class DonationPass(LintPass):
+    name = "use-after-donate"
+    doc = "reads of a buffer after it was donated into a jit dispatch"
+
+    def run(self, ctx: LintContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for src in ctx.files:
+            if src.tree is None:
+                continue
+            fns = astutil.function_defs(src.tree)
+            # Module-global donators: decorated functions and dotted
+            # (attribute) targets like `self._step` — callable from any
+            # scope.  Plain-name assignments are scoped to the function
+            # that makes them: `step` in one helper is not `step` in
+            # another.
+            global_don = self._scope_donators(src.tree, dotted_only=True)
+            global_don.update(self._decorated_donators(src.tree))
+            scopes: List[Tuple[List[ast.stmt], Dict]] = [
+                (list(src.tree.body), self._scope_donators(src.tree))]
+            for fn in fns:
+                scopes.append((list(fn.body), self._scope_donators(fn)))
+            for body, local_don in scopes:
+                donators = dict(global_don)
+                donators.update(local_don)
+                if not donators:
+                    continue
+                w = _ScopeWalker(self, src.rel, donators)
+                w.walk(body)
+                findings.extend(w.findings)
+        return findings
+
+    # -- phase A: donator collection ----------------------------------------
+
+    def _scope_donators(self, scope: ast.AST,
+                        dotted_only: bool = False) -> Dict[str, Tuple[int, ...]]:
+        """Donating assignments within ``scope``.  ``dotted_only`` keeps
+        attribute paths (``self._step``), collected module-wide for the
+        global map; otherwise plain names assigned in the scope's OWN
+        statements (nested defs excluded) are returned."""
+        nodes = (list(ast.walk(scope)) if dotted_only
+                 else astutil.scope_nodes(scope))
+        assigns: Dict[str, ast.AST] = {}
+        for node in nodes:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t = astutil.dotted(node.targets[0])
+                if t is not None and "." not in t:
+                    assigns[t] = node.value
+        donators: Dict[str, Tuple[int, ...]] = {}
+        for node in nodes:
+            if not isinstance(node, ast.Assign) \
+                    or not isinstance(node.value, ast.Call):
+                continue
+            nums = _donating_call(node.value, assigns)
+            if not nums:
+                continue
+            for t in node.targets:
+                path = astutil.dotted(t)
+                if path is None:
+                    continue
+                if dotted_only != ("." in path):
+                    continue
+                donators[path] = nums
+        return donators
+
+    def _decorated_donators(self, tree: ast.Module) -> Dict[str, Tuple[int, ...]]:
+        """@partial(jax.jit, donate_argnums=...) / @jax.jit(...) forms."""
+        donators: Dict[str, Tuple[int, ...]] = {}
+        for fn in astutil.function_defs(tree):
+            for d in fn.decorator_list:
+                if isinstance(d, ast.Call):
+                    names = {astutil.dotted(d.func) or ""} | {
+                        astutil.dotted(a) or "" for a in d.args}
+                    if not ({"jax.jit", "jit"} & names):
+                        continue
+                    for kw in d.keywords:
+                        if kw.arg == "donate_argnums":
+                            nums = _resolve_argnums(kw.value, {})
+                            if nums:
+                                donators[fn.name] = nums
+        return donators

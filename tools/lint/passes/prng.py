@@ -1,0 +1,193 @@
+"""prng-reuse: a PRNG key consumed twice, or loop-invariantly.
+
+PR 7's ``keyed_dropout`` refactor made key discipline explicit: every
+random draw must consume a FRESH key (``split`` / ``fold_in`` fold), or
+two "independent" draws silently correlate — packed dropout masks that
+equal each other, DP noise that repeats across rounds.  This pass
+flags:
+
+1. **double consumption** — the same key name passed to two
+   ``jax.random.<draw>`` / ``*dropout*`` call sites without an
+   intervening rebind (``split``/``fold_in`` reassignment or any other
+   store).  Exclusive ``if/else`` branches don't cross-report.
+2. **loop-invariant keys** — a ``for``/``while`` body that consumes a
+   key neither rebound inside the loop nor bound by the loop target:
+   every iteration draws the identical stream.
+
+``split`` and ``fold_in`` are *derivers*, not consumers — calling
+``split(key)`` twice is the documented step/step_prebatched re-split
+contract, not a bug.  Tests are out of scope: re-consuming a key to
+assert bit-identity is the POINT of half the regression suite.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.lint import astutil
+from tools.lint.core import Finding, LintContext, LintPass
+
+# jax.random.* that derive new keys rather than consuming entropy.
+_DERIVERS = {"split", "fold_in", "PRNGKey", "key", "key_data",
+             "wrap_key_data", "clone", "key_impl"}
+
+
+def _consumed_key(call: ast.Call) -> Optional[str]:
+    """The dotted key-name this call CONSUMES, if any."""
+    cn = astutil.call_name(call)
+    if cn is None:
+        return None
+    parts = cn.split(".")
+    is_draw = (len(parts) >= 2 and parts[-2] == "random"
+               and parts[-1] not in _DERIVERS)
+    is_dropout = "dropout" in parts[-1].lower()
+    if not (is_draw or is_dropout):
+        return None
+    # The key rides arg 0 by convention (jax.random API, keyed_dropout).
+    for cand in (call.args[0] if call.args else None,
+                 *[kw.value for kw in call.keywords if kw.arg == "key"]):
+        if cand is not None:
+            path = astutil.dotted(cand)
+            if path is None:
+                continue
+            if is_draw:
+                return path
+            # Dropout helpers: only a key-ish first argument counts (a
+            # `Dropout(rate)` constructor's float is not a key).
+            base = path.split(".")[-1]
+            if base == "k" or base.startswith(("k_", "key", "rng")) \
+                    or "key" in base or "rng" in base:
+                return path
+    return None
+
+
+class _Scope:
+    def __init__(self, owner: "PrngPass", rel: str):
+        self.owner = owner
+        self.rel = rel
+        self.consumed: Dict[str, int] = {}  # key path -> first consume line
+        self.findings: List[Finding] = []
+        self._seen: Set[Tuple[int, str]] = set()
+
+    def walk(self, body: List[ast.stmt]) -> None:
+        for stmt in body:
+            self._statement(stmt)
+
+    def _statement(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return
+        if isinstance(stmt, ast.If):
+            self._header_consumes(stmt.test)
+            before = dict(self.consumed)
+            self.walk(list(stmt.body))
+            after_body = dict(self.consumed)
+            self.consumed = dict(before)
+            self.walk(list(stmt.orelse))
+            # Exclusive branches: merge by keeping the EARLIEST record so
+            # later statements still see both branches' consumption, but
+            # the branches never cross-report against each other.
+            for k, v in after_body.items():
+                self.consumed[k] = min(v, self.consumed.get(k, v))
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+            self._loop(stmt)
+            return
+        if isinstance(stmt, ast.Try):
+            self.walk(list(stmt.body))
+            for h in stmt.handlers:
+                self.walk(list(h.body))
+            self.walk(list(stmt.orelse))
+            self.walk(list(stmt.finalbody))
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            self._header_consumes(*[i.context_expr for i in stmt.items])
+            for path in astutil.assign_target_paths(stmt):
+                self._rebind(path)
+            self.walk(list(stmt.body))
+            return
+        self._header_consumes(stmt)
+        for path in astutil.assign_target_paths(stmt):
+            self._rebind(path)
+
+    def _loop(self, stmt) -> None:
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._header_consumes(stmt.iter)
+        else:
+            self._header_consumes(stmt.test)
+        bound: Set[str] = set()
+        for sub in ast.walk(stmt):
+            if isinstance(sub, ast.stmt):
+                bound.update(astutil.assign_target_paths(sub))
+        consumed_in_body: List[Tuple[str, int]] = []
+        for sub in ast.walk(stmt):
+            if isinstance(sub, ast.Call):
+                path = _consumed_key(sub)
+                if path is not None:
+                    consumed_in_body.append((path, sub.lineno))
+        for path, line in consumed_in_body:
+            root = path.split(".")[0]
+            if path in bound or root in bound:
+                continue
+            key = (line, path)
+            if key in self._seen:
+                continue
+            self._seen.add(key)
+            self.findings.append(Finding(
+                self.owner.name, self.rel, line,
+                f"loop consumes the loop-invariant key '{path}': every "
+                "iteration draws the identical random stream",
+                fix_hint="fold the loop index in (key = fold_in(key, i)) "
+                         "or split per iteration"))
+        # Body consumption also counts toward straight-line double use
+        # after the loop, and rebinds inside the body revive.
+        self.walk(list(getattr(stmt, "body", [])))
+        self.walk(list(getattr(stmt, "orelse", [])))
+
+    def _header_consumes(self, *nodes: ast.AST) -> None:
+        for node in nodes:
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                path = _consumed_key(sub)
+                if path is None:
+                    continue
+                first = self.consumed.get(path)
+                if first is not None and (sub.lineno, path) not in self._seen:
+                    self._seen.add((sub.lineno, path))
+                    self.findings.append(Finding(
+                        self.owner.name, self.rel, sub.lineno,
+                        f"key '{path}' already consumed at line {first} is "
+                        "consumed again without an intervening "
+                        "split/fold_in: the two draws are identical streams",
+                        fix_hint="split the key (k1, k2 = split(key)) or "
+                                 "fold a distinct constant in per site"))
+                else:
+                    self.consumed.setdefault(path, sub.lineno)
+
+    def _rebind(self, path: str) -> None:
+        self.consumed.pop(path, None)
+        for p in [p for p in self.consumed if p.startswith(path + ".")]:
+            self.consumed.pop(p, None)
+
+
+class PrngPass(LintPass):
+    name = "prng-reuse"
+    doc = "a key consumed by two draws without split/fold_in in between"
+
+    def run(self, ctx: LintContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for src in ctx.files:
+            # test_*.py is out of scope: re-consuming a key to assert
+            # bit-identity is the POINT of half the regression suite.
+            if src.tree is None or src.path.name.startswith("test_"):
+                continue
+            for fn in astutil.function_defs(src.tree):
+                scope = _Scope(self, src.rel)
+                scope.walk(list(fn.body))
+                findings.extend(scope.findings)
+            scope = _Scope(self, src.rel)
+            scope.walk(list(src.tree.body))
+            findings.extend(scope.findings)
+        return findings

@@ -1,0 +1,169 @@
+"""jit-purity: host-side effects traced into jitted round programs.
+
+ADVICE r5 #1's bug class: an ``os.environ`` read inside a function that
+``jax.jit`` traces executes ONCE at trace time and is then baked into
+the cached executable — flipping the env var later silently has no
+effect on that program (the pallas_round MXU_FINISH bug).  The same
+goes for ``time.*`` (a constant timestamp), ``print`` (fires at trace,
+silent at run), and global mutation (happens once, not per step).
+
+Two ways a function counts as traced:
+
+* **reachable from a jit entry point in its module** — a function
+  decorated ``@jax.jit`` / ``@partial(jax.jit, ...)``, named ``*_jit``,
+  or passed by name into ``jax.jit(...)`` / ``cached_jit(...)``; the
+  intra-module call graph (plain-name and ``self.method`` calls, plus
+  functions passed as arguments from traced bodies — ``lax.scan``
+  bodies and vmapped closures) closes over it.
+* **defined in a round-body module** — the host-sync DEVICE_SIDE list
+  plus the model definitions, whose code exists to be traced; there
+  every function is suspect.  The deliberate trace-time escape hatches
+  (fresh-process env toggles) carry pragmas with their contract.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+from tools.lint import astutil
+from tools.lint.core import Finding, LintContext, LintPass
+from tools.lint.passes.host_sync import DEVICE_SIDE
+
+# Modules whose entire surface is trace-candidate code.
+TRACED_MODULES = DEVICE_SIDE + (
+    "blades_tpu/models/layers.py",
+    "blades_tpu/models/mlp.py",
+    "blades_tpu/models/cnn.py",
+    "blades_tpu/models/resnet.py",
+    "blades_tpu/models/cct.py",
+)
+
+# Dotted prefixes whose evaluation inside a traced body is a host effect
+# baked in at trace time.
+_IMPURE_PREFIXES = (
+    "os.environ", "os.getenv", "os.putenv",
+    "time.time", "time.perf_counter", "time.monotonic", "time.sleep",
+    "time.process_time",
+    "datetime.now", "datetime.datetime.now", "datetime.utcnow",
+    "np.random", "numpy.random", "random.random", "random.randint",
+    "random.choice", "random.shuffle", "random.seed",
+)
+_IMPURE_CALLS = {"print", "input", "open", "breakpoint"}
+
+_HINT = ("resolve host state OUTSIDE the traced function (an un-jitted "
+         "wrapper, a static config field) and pass the result in; a "
+         "traced read executes once at trace time and is baked into "
+         "every cached executable")
+
+
+# Nested defs are analyzed as their own functions (traced iff reachable
+# themselves), so their contents must not be attributed to the parent.
+# Lambdas stay: a lambda's body runs inline within the enclosing trace.
+_NESTED_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+
+
+def _impure_nodes(fn: ast.AST) -> List[tuple]:
+    """(line, description) for each impure construct in this body."""
+    out = []
+    for sub in astutil.scope_nodes(fn, prune=_NESTED_SCOPES):
+        if isinstance(sub, ast.Global):
+            out.append((sub.lineno, "`global` statement (trace-time "
+                        "mutation happens once, not per step)"))
+        elif isinstance(sub, (ast.Attribute, ast.Name)):
+            path = astutil.dotted(sub)
+            if path and any(path == p or path.startswith(p + ".")
+                            for p in _IMPURE_PREFIXES):
+                out.append((sub.lineno, f"`{path}` read"))
+        elif isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name) \
+                and sub.func.id in _IMPURE_CALLS:
+            out.append((sub.lineno, f"`{sub.func.id}()` call"))
+    # Dedupe attribute chains (os.environ.get reports once per chain).
+    seen: Set[tuple] = set()
+    uniq = []
+    for line, what in out:
+        if (line, what.split(".")[0]) not in seen:
+            seen.add((line, what.split(".")[0]))
+            uniq.append((line, what))
+    return uniq
+
+
+class PurityPass(LintPass):
+    name = "jit-purity"
+    doc = ("os.environ / time.* / print / global mutation reachable "
+           "from a jitted entry point")
+
+    def __init__(self, traced_modules: Optional[Sequence[str]] = None):
+        self.traced_modules = (tuple(traced_modules)
+                               if traced_modules is not None
+                               else TRACED_MODULES)
+
+    def run(self, ctx: LintContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for src in ctx.files:
+            if src.tree is None:
+                continue
+            fns = astutil.function_defs(src.tree)
+            by_name: Dict[str, List[ast.AST]] = {}
+            for fn in fns:
+                by_name.setdefault(fn.name, []).append(fn)
+            whole_module = src.rel in self.traced_modules
+            if whole_module:
+                traced = {fn.name for fn in fns}
+                entry_of = {fn.name: f"round-body module {src.rel}"
+                            for fn in fns}
+            else:
+                traced, entry_of = self._reach(src, fns, by_name)
+            for fn in fns:
+                if fn.name not in traced:
+                    continue
+                for line, what in _impure_nodes(fn):
+                    findings.append(Finding(
+                        self.name, src.rel, line,
+                        f"{what} inside `{fn.name}` "
+                        f"(traced: {entry_of[fn.name]})",
+                        fix_hint=_HINT))
+        return findings
+
+    # -- reachability -------------------------------------------------------
+
+    def _reach(self, src, fns, by_name) -> tuple:
+        entries: Dict[str, str] = {}
+        for fn in fns:
+            decos = astutil.decorator_names(fn)
+            if any(d in ("jit", "jax.jit", "pjit", "jax.pjit")
+                   for d in decos):
+                entries[fn.name] = f"@jit entry `{fn.name}`"
+            elif fn.name.endswith("_jit"):
+                entries[fn.name] = f"`{fn.name}` (_jit naming contract)"
+        if src.tree is not None:
+            for call in astutil.walk_calls(src.tree):
+                cn = astutil.call_name(call)
+                if cn and cn.split(".")[-1] in ("jit", "cached_jit", "pjit") \
+                        and call.args:
+                    target = astutil.dotted(call.args[0])
+                    if target and target in by_name:
+                        entries.setdefault(
+                            target, f"passed to {cn}() as `{target}`")
+        # Close over the intra-module call graph: in a traced body, any
+        # plain-name reference to a module function is traced too (called
+        # directly, or passed into lax.scan/vmap/cond).
+        traced: Set[str] = set(entries)
+        entry_of: Dict[str, str] = dict(entries)
+        frontier = list(entries)
+        while frontier:
+            name = frontier.pop()
+            for fn in by_name.get(name, []):
+                for sub in ast.walk(fn):
+                    ref = None
+                    if isinstance(sub, ast.Name) and isinstance(
+                            sub.ctx, ast.Load):
+                        ref = sub.id
+                    elif isinstance(sub, ast.Attribute) and isinstance(
+                            sub.value, ast.Name) and sub.value.id == "self":
+                        ref = sub.attr
+                    if ref and ref in by_name and ref not in traced:
+                        traced.add(ref)
+                        entry_of[ref] = entry_of[name]
+                        frontier.append(ref)
+        return traced, entry_of

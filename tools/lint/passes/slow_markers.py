@@ -1,0 +1,115 @@
+"""slow-markers: tier-1 tests that build the 8-device mesh unmarked.
+
+Folded in from ``tools/check_tier1_budget.py`` so all static analysis
+runs through one framework (that tool now delegates here and keeps only
+the wall-time budget guard, which needs a pytest log, not an AST).
+
+Mesh compiles are the single most expensive test class on the tier-1
+box; a test (or a fixture it requests) calling ``make_mesh`` /
+``shard_federation`` without a ``slow`` marker silently eats the 870 s
+budget.  Module-level ``pytestmark = pytest.mark.slow`` covers a whole
+file.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Iterable, List
+
+from tools.lint.core import Finding, LintContext, LintPass
+
+MESH_CALLS = {"make_mesh", "shard_federation"}
+
+
+def _has_slow_mark(deco_list) -> bool:
+    for d in deco_list:
+        for node in ast.walk(d):
+            if isinstance(node, ast.Attribute) and node.attr == "slow":
+                return True
+    return False
+
+
+def _is_fixture(deco_list) -> bool:
+    for d in deco_list:
+        for node in ast.walk(d):
+            if isinstance(node, ast.Attribute) and node.attr == "fixture":
+                return True
+            if isinstance(node, ast.Name) and node.id == "fixture":
+                return True
+    return False
+
+
+def _module_slow(tree: ast.Module) -> bool:
+    """``pytestmark = pytest.mark.slow`` (or a list containing it)."""
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == "pytestmark"
+            for t in node.targets
+        ):
+            for sub in ast.walk(node.value):
+                if isinstance(sub, ast.Attribute) and sub.attr == "slow":
+                    return True
+    return False
+
+
+def _calls_mesh(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            f = node.func
+            name = f.id if isinstance(f, ast.Name) else (
+                f.attr if isinstance(f, ast.Attribute) else None)
+            if name in MESH_CALLS:
+                return True
+    return False
+
+
+def audit_tree(tree: ast.Module, display_name: str) -> List[Finding]:
+    """Unmarked mesh tests in one parsed test module."""
+    if _module_slow(tree):
+        return []
+    functions = [n for n in ast.walk(tree)
+                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    mesh_fixtures = {fn.name for fn in functions
+                     if _is_fixture(fn.decorator_list) and _calls_mesh(fn)}
+    findings = []
+    for fn in functions:
+        if not fn.name.startswith("test"):
+            continue
+        if _has_slow_mark(fn.decorator_list):
+            continue
+        args = {a.arg for a in fn.args.args}
+        if not (_calls_mesh(fn) or (args & mesh_fixtures)):
+            continue
+        via = (f"fixture {sorted(args & mesh_fixtures)[0]!r}"
+               if args & mesh_fixtures else "direct mesh call")
+        findings.append(Finding(
+            "slow-markers", display_name, fn.lineno,
+            f"{fn.name} builds the 8-device mesh ({via}) without "
+            "@pytest.mark.slow",
+            fix_hint="mark it slow (or module-level pytestmark) so it "
+                     "rides the tier-2 lane, not the 870 s tier-1 budget"))
+    return findings
+
+
+def audit_path(path: Path) -> List[Finding]:
+    """Standalone-file entry point (check_tier1_budget delegates here)."""
+    try:
+        tree = ast.parse(path.read_text(), filename=str(path))
+    except SyntaxError as exc:
+        return [Finding("slow-markers", str(path), exc.lineno or 1,
+                        f"unparseable ({exc.msg})")]
+    return audit_tree(tree, str(path))
+
+
+class SlowMarkersPass(LintPass):
+    name = "slow-markers"
+    doc = "tier-1 tests building the 8-device mesh without @pytest.mark.slow"
+
+    def run(self, ctx: LintContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for src in ctx.matching(["tests"]):
+            if src.tree is None or not src.path.name.startswith("test_"):
+                continue
+            findings.extend(audit_tree(src.tree, src.rel))
+        return findings

@@ -1,0 +1,132 @@
+"""static-config: static jit-arg dataclasses must be frozen + hashable.
+
+The config objects threaded into the round program as STATIC values —
+``*Config`` in ``algorithms/``/``faults/``/``comm/``, the
+``FaultInjector``, ``ClientPacking`` — are jit cache keys: jax hashes
+them to decide whether a dispatch reuses a compiled executable.  A
+mutable (unfrozen) config silently mutates under a cached program; an
+unhashable field (list/dict/ndarray annotation, ``default_factory=
+list``) raises at dispatch — or worse, hashes by identity and splits
+the cache.  Verified structurally: ``@dataclass(frozen=True)`` with
+``eq`` left True and every field annotation hashable.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Sequence
+
+from tools.lint import astutil
+from tools.lint.core import Finding, LintContext, LintPass
+
+# Where static-config dataclasses live (ISSUE 8) and what they look like.
+CONFIG_PREFIXES = ("blades_tpu/algorithms", "blades_tpu/faults",
+                   "blades_tpu/comm", "blades_tpu/parallel/packed.py")
+_NAME_SUFFIXES = ("Config", "Injector", "Packing")
+
+# Annotation heads that cannot be hashed (and so cannot key a jit cache).
+_UNHASHABLE_HEADS = {"list", "List", "dict", "Dict", "set", "Set",
+                     "bytearray", "MutableMapping", "MutableSequence",
+                     "ndarray", "np.ndarray", "numpy.ndarray",
+                     "jnp.ndarray", "jax.Array", "Array"}
+
+
+def _dataclass_decorator(cls: ast.ClassDef) -> Optional[ast.AST]:
+    for d in cls.decorator_list:
+        name = astutil.dotted(d if not isinstance(d, ast.Call) else d.func)
+        if name and name.split(".")[-1] == "dataclass":
+            return d
+    return None
+
+
+def _kw_value(deco: ast.AST, kw_name: str):
+    if isinstance(deco, ast.Call):
+        for kw in deco.keywords:
+            if kw.arg == kw_name and isinstance(kw.value, ast.Constant):
+                return kw.value.value
+    return None
+
+
+def _annotation_heads(node: ast.AST) -> List[str]:
+    """Every dotted head in an annotation: ``Optional[List[int]]`` yields
+    Optional, List, int."""
+    heads = []
+    for sub in ast.walk(node):
+        d = astutil.dotted(sub)
+        if d is not None:
+            heads.append(d)
+        elif isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            # String annotations: parse and recurse.
+            try:
+                heads.extend(_annotation_heads(
+                    ast.parse(sub.value, mode="eval").body))
+            except SyntaxError:
+                pass
+    return heads
+
+
+class StaticArgsPass(LintPass):
+    name = "static-config"
+    doc = "static jit-arg dataclasses: frozen=True, eq on, hashable fields"
+
+    def __init__(self, prefixes: Optional[Sequence[str]] = None):
+        self.prefixes = (tuple(prefixes) if prefixes is not None
+                         else CONFIG_PREFIXES)
+
+    def run(self, ctx: LintContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for src in ctx.matching(list(self.prefixes)):
+            if src.tree is None:
+                continue
+            for node in ast.walk(src.tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                if not node.name.endswith(_NAME_SUFFIXES):
+                    continue
+                deco = _dataclass_decorator(node)
+                if deco is None:
+                    continue
+                findings.extend(self._check(src.rel, node, deco))
+        return findings
+
+    def _check(self, rel: str, cls: ast.ClassDef,
+               deco: ast.AST) -> Iterable[Finding]:
+        if _kw_value(deco, "frozen") is not True:
+            yield Finding(
+                self.name, rel, cls.lineno,
+                f"static config dataclass {cls.name} is not frozen=True: "
+                "a mutable jit cache key silently mutates under a cached "
+                "program",
+                fix_hint="@dataclasses.dataclass(frozen=True)")
+        if _kw_value(deco, "eq") is False:
+            yield Finding(
+                self.name, rel, cls.lineno,
+                f"static config dataclass {cls.name} sets eq=False: "
+                "identity-hashing splits the jit cache per instance",
+                fix_hint="leave eq at its True default")
+        for stmt in cls.body:
+            if not isinstance(stmt, ast.AnnAssign) or not isinstance(
+                    stmt.target, ast.Name):
+                continue
+            bad = [h for h in _annotation_heads(stmt.annotation)
+                   if h in _UNHASHABLE_HEADS
+                   or h.split(".")[-1] in _UNHASHABLE_HEADS]
+            if bad:
+                yield Finding(
+                    self.name, rel, stmt.lineno,
+                    f"{cls.name}.{stmt.target.id} is annotated with "
+                    f"unhashable {sorted(set(bad))}: the instance cannot "
+                    "key a jit cache",
+                    fix_hint="use a tuple / frozenset / scalar, converting "
+                             "in __post_init__ if callers pass lists")
+            if isinstance(stmt.value, ast.Call):
+                cn = astutil.call_name(stmt.value) or ""
+                if cn.split(".")[-1] == "field":
+                    for kw in stmt.value.keywords:
+                        if kw.arg == "default_factory" and astutil.dotted(
+                                kw.value) in ("list", "dict", "set"):
+                            yield Finding(
+                                self.name, rel, stmt.lineno,
+                                f"{cls.name}.{stmt.target.id} defaults to a "
+                                f"mutable {astutil.dotted(kw.value)}()",
+                                fix_hint="default to () / frozenset() / None")
