@@ -423,6 +423,97 @@ def _packed_cnn_block() -> dict:
             "packed_speedup": speedup}
 
 
+def _measure_rowgeom_round(aggregator: str, fused: bool | None, *, model,
+                           input_shape, num_clients, num_byzantine,
+                           client_block, d_chunk, timed_rounds) -> dict:
+    """One streamed row-geometry configuration (FedAvg + ALIE forge +
+    ``aggregator``), measured end to end.  ``fused`` toggles the pass
+    planner's fusion (``streamed_step(fuse_rowgeom=...)``); ``None``
+    runs the Mean-aggregator baseline of the SAME protocol, whose
+    trivial finish isolates the training cost so the A/B's finish
+    wall-time can be derived as ``round_s - baseline_round_s``."""
+    from blades_tpu.adversaries import get_adversary, make_malicious_mask
+    from blades_tpu.core import FedRound, Server, TaskSpec
+    from blades_tpu.parallel.streamed import streamed_step
+
+    task = TaskSpec(model=model, input_shape=input_shape, num_classes=10,
+                    lr=0.1).build()
+    agg_name = "Mean" if fused is None else aggregator
+    server = Server.from_config(aggregator=agg_name,
+                                num_byzantine=num_byzantine, lr=0.5)
+    adv = get_adversary("ALIE", num_clients=num_clients,
+                        num_byzantine=num_byzantine)
+    fr = FedRound(task=task, server=server, adversary=adv,
+                  batch_size=min(BATCH, 8),
+                  num_batches_per_round=LOCAL_STEPS)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(num_clients, 8, *input_shape)),
+                    jnp.float32)
+    y = jnp.asarray(rng.integers(0, 10, size=(num_clients, 8)), jnp.int32)
+    lengths = jnp.full((num_clients,), 8, jnp.int32)
+    mal = make_malicious_mask(num_clients, num_byzantine)
+    step = streamed_step(fr, client_block=client_block, d_chunk=d_chunk,
+                         fuse_rowgeom=True if fused is None else fused)
+    state = fr.init(jax.random.PRNGKey(0), num_clients)
+    state, m = step(state, x, y, lengths, mal, jax.random.PRNGKey(1))
+    _ = float(m["train_loss"])  # compile + settle
+    t0 = time.perf_counter()
+    for r in range(timed_rounds):
+        state, m = step(state, x, y, lengths, mal,
+                        jax.random.fold_in(jax.random.PRNGKey(2), r))
+    final_loss = float(m["train_loss"])
+    assert final_loss == final_loss  # NaN guard
+    dt = time.perf_counter() - t0
+    out = {
+        "aggregator": agg_name,
+        "round_s": round(dt / timed_rounds, 4),
+        "rounds_per_sec": round(timed_rounds / dt, 4),
+        "clients": num_clients, "byzantine": num_byzantine, "model": model,
+        "timed_rounds": timed_rounds,
+    }
+    if fused is not None:
+        out["fused"] = fused
+        # Planned full-matrix traversals per finish, stamped by the round
+        # (obs schema fields hbm_passes / hbm_passes_unfused).
+        out["hbm_passes"] = int(m["hbm_passes"])
+        out["hbm_passes_unfused"] = int(m["hbm_passes_unfused"])
+    return out
+
+
+def _rowgeom_block(cpu: bool) -> dict:
+    """BLADES_BENCH_ROWGEOM satellite: the Multikrum/GeoMed streamed
+    fused-vs-unfused A/B (ISSUE 9), riding the TPU-probe + cpu_fallback
+    machinery like the packed A/B.  Per aggregator: planned finish pass
+    counts (``hbm_passes``), round wall-times for both plans, and the
+    finish wall-time derived against a Mean-baseline round of the same
+    protocol (identical training, trivial finish).  cpu_fallback numbers
+    are comparable only with other cpu_fallback rounds."""
+    if cpu:
+        cfg = dict(model="mlp", input_shape=(8, 8, 1), num_clients=16,
+                   num_byzantine=4, client_block=4, d_chunk=1 << 14,
+                   timed_rounds=2)
+    else:
+        cfg = dict(model="resnet10", input_shape=(32, 32, 3),
+                   num_clients=200, num_byzantine=50, client_block=50,
+                   d_chunk=D_CHUNK, timed_rounds=2)
+    base = _measure_rowgeom_round("Mean", None, **cfg)
+    out = {"baseline_mean": base}
+    for agg in ("Multikrum", "GeoMed"):
+        fused = _measure_rowgeom_round(agg, True, **cfg)
+        unfused = _measure_rowgeom_round(agg, False, **cfg)
+        finish_f = max(fused["round_s"] - base["round_s"], 0.0)
+        finish_u = max(unfused["round_s"] - base["round_s"], 0.0)
+        out[agg.lower()] = {
+            "fused": fused,
+            "unfused": unfused,
+            "finish_s_fused": round(finish_f, 4),
+            "finish_s_unfused": round(finish_u, 4),
+            "finish_speedup": (round(finish_u / finish_f, 3)
+                               if finish_f > 0 else None),
+        }
+    return out
+
+
 def _cpu_fallback(probe_err: str) -> None:
     """The relay-dead-box path: measure a REDUCED configuration of the
     same pipeline (FedAvg + ALIE forge + exact Median, dense round, CPU
@@ -465,6 +556,13 @@ def _cpu_fallback(probe_err: str) -> None:
                     packed["rounds_per_sec"] / unpacked["rounds_per_sec"], 3)
         except Exception as e:
             out["packed"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+    if os.environ.get("BLADES_BENCH_ROWGEOM", "1") == "1":
+        try:
+            # Row-geometry pass-fusion A/B (ISSUE 9) on the reduced CPU
+            # config — fused vs unfused streamed Multikrum/GeoMed.
+            out["rowgeom"] = _rowgeom_block(cpu=True)
+        except Exception as e:
+            out["rowgeom"] = {"error": f"{type(e).__name__}: {e}"[:300]}
     _emit(out)
 
 
@@ -534,6 +632,15 @@ def main() -> None:
             out["packed_cnn"] = _packed_cnn_block()
         except Exception as e:
             out["packed_cnn"] = {"error": f"{type(e).__name__}: {e}"[:500]}
+
+    if os.environ.get("BLADES_BENCH_ROWGEOM", "1") == "1":
+        try:
+            # Row-geometry pass-fusion A/B (ISSUE 9): streamed Multikrum/
+            # GeoMed with the pass planner fused vs de-fused, finish
+            # wall-time derived against a Mean-baseline round.
+            out["rowgeom"] = _rowgeom_block(cpu=False)
+        except Exception as e:
+            out["rowgeom"] = {"error": f"{type(e).__name__}: {e}"[:500]}
 
     _emit(out)
 

@@ -628,6 +628,12 @@ class Fedavg:
             row["pack_factor"] = int(packing.pack)
             row["packed_lanes"] = int(self.config.num_clients
                                       // packing.pack)
+        if "hbm_passes" in metrics:
+            # Row-geometry pass-fusion accounting (streamed path): planned
+            # full-matrix traversals per finish, fused plan vs the
+            # per-statistic baseline (parallel/streamed_geometry.py).
+            row["hbm_passes"] = int(metrics["hbm_passes"])
+            row["hbm_passes_unfused"] = int(metrics["hbm_passes_unfused"])
         if "elided_lanes" in metrics:
             # Malicious-lane training elision engaged (streamed/d-sharded
             # paths): surfaces the optimistic num_unhealthy basis — an

@@ -82,6 +82,14 @@ ROUND_RECORD_FIELDS: Dict[str, Tuple[tuple, bool]] = {
     # num_unhealthy basis — elided lanes can never trip health counters —
     # is visible in telemetry.
     "elided_lanes": ((int,), False),
+    # Row-geometry pass fusion (parallel/streamed_geometry.py): planned
+    # full-matrix HBM traversals the streamed row-geometry finish runs
+    # this round under the fused pass plan, vs what the
+    # one-traversal-per-statistic baseline would run.  Static per config
+    # (data-dependent Weiszfeld loops count their maxiter bound), so the
+    # fused/unfused ratio is visible in metrics.jsonl without a TPU.
+    "hbm_passes": ((int,), False),
+    "hbm_passes_unfused": ((int,), False),
     # perf layer (blades_tpu/perf): AOT executable-cache traffic,
     # cumulative per trial — a trial whose round program was served from
     # the cache reports misses == 0 from its first row.

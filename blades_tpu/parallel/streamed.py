@@ -25,10 +25,15 @@ The whole aggregator suite runs here.  The coordinate-wise slice —
 Mean / Median / Trimmedmean, exactly the BASELINE.json headline workload
 (FedAvg + ALIE + Median) — aggregates inside the chunked (or fused
 pallas) finish.  The row-geometry aggregators (GeoMed, Multikrum, DnC,
-Centeredclipping, Signguard, Clippedclustering, FLTrust) run as
-full-matrix passes over the stored buffer
-(:mod:`blades_tpu.parallel.streamed_geometry`) after a materialization
-scan writes sanitize/DP back into it.  Update-forging adversaries run
+Centeredclipping, Signguard, Clippedclustering, FLTrust) run as fused
+full-matrix pass bundles over the stored buffer — statistics requested
+through the pass planner
+(:mod:`blades_tpu.parallel.streamed_geometry`), executed one HBM
+traversal per bundle (the pallas row-stats kernel,
+:mod:`blades_tpu.ops.pallas_rowstats`, on eligible TPU shapes; a
+``lax.scan`` chunk loop otherwise), with planned traversal counts
+stamped per round as ``hbm_passes``/``hbm_passes_unfused`` — after a
+materialization scan writes sanitize/DP back into it.  Update-forging adversaries run
 either fused into the finish (coordinate-wise: ALIE, IPM, Noise,
 Adaptive) or — for the row-geometry attacks MinMax, SignGuard-attack
 and Attackclippedclustering — as stats passes producing one forged
@@ -129,6 +134,7 @@ def streamed_step(
     update_dtype=jnp.bfloat16,
     donate: bool = True,
     malicious_prefix: int | None = None,
+    fuse_rowgeom: bool = True,
 ) -> Callable:
     """Build the streaming round (a host-side callable over jitted parts).
 
@@ -179,8 +185,18 @@ def streamed_step(
             malicious client that would have trained to NaN no longer
             trips ``num_unhealthy``.  ``None`` (default) trains every
             lane.
+        fuse_rowgeom: run the row-geometry finish through the fused pass
+            planner (default).  ``False`` executes one traversal per
+            accumulator request — the pre-fusion baseline the
+            ``BLADES_BENCH_ROWGEOM`` A/B and equivalence tests compare
+            against.  Row-geometry rounds stamp ``hbm_passes`` /
+            ``hbm_passes_unfused`` (planned full-matrix traversals,
+            fused plan vs per-request baseline) into the round metrics.
     """
-    from blades_tpu.parallel.streamed_geometry import STREAMED_ROW_AGGREGATORS
+    from blades_tpu.parallel.streamed_geometry import (
+        STREAMED_ROW_AGGREGATORS,
+        PassRecorder,
+    )
 
     agg = fr.server.aggregator
     row_geom = isinstance(agg, STREAMED_ROW_AGGREGATORS)
@@ -216,6 +232,9 @@ def streamed_step(
         )
     forges = coord_forges
     hooks = fr._hooks()
+    # Planned-traversal accounting for the row-geometry finish: fills at
+    # trace time (first round), frozen after the first stamp.
+    _pass_recorder = PassRecorder()
 
     def _dp_chunk(chunk, row_norms, k_dp, i):
         """Per-chunk DP clip + noise against the train-time full-row
@@ -443,7 +462,12 @@ def streamed_step(
 
         from blades_tpu.parallel.streamed_geometry import new_cols
 
-        n, d = updates_buf.shape
+        n = updates_buf.shape[0]
+        # d_model, not the buffer width: rowgeom buffers may carry
+        # stripe-alignment padding columns (zeros) the materialization
+        # must never rewrite — a forged/noised padding column would
+        # corrupt the kernel's whole-stripe statistics.
+        d = d_model
         c = min(d_chunk, d)
         raw = lax.dynamic_slice(updates_buf, (0, start), (n, c))
         chunk = raw.astype(jnp.float32)
@@ -473,37 +497,41 @@ def streamed_step(
         return updates_buf, sq_acc, bad_acc
 
     @jax.jit
-    def _rowgeom_sq(updates_buf):
-        from blades_tpu.parallel.streamed_geometry import row_sq_norms
-
-        return row_sq_norms(updates_buf, d_chunk)
-
-    @jax.jit
     def _rowgeom_aggregate(server_state, updates_buf, malicious, losses,
                            sq, bad_rows, k_agg):
-        """Aggregator passes over the (read-only, post-materialization)
-        buffer + the shared serve tail."""
+        """Fused aggregator bundles over the (read-only,
+        post-materialization) buffer + the shared serve tail.  ``sq`` is
+        ``None`` on the read-only path — the row-norm request then fuses
+        into the aggregator's first statistics traversal instead of
+        costing its own pass."""
         from blades_tpu.parallel.streamed_geometry import aggregate_streamed
 
         trusted = fr.compute_trusted_update(
             server_state.params, jax.random.fold_in(k_agg, 1)
         )
-        agg_vec, agg_state = aggregate_streamed(
+        agg_vec, agg_state, sq = aggregate_streamed(
             agg, updates_buf, sq, server_state.agg_state, key=k_agg,
-            trusted=trusted, d_chunk=d_chunk,
+            trusted=trusted, d_chunk=d_chunk, d=d_model,
+            recorder=_pass_recorder, fuse=fuse_rowgeom,
         )
         return _serve_aggregate(server_state, agg_vec, malicious, losses,
                                 sq, bad_rows, agg_state=agg_state)
 
     @jax.jit
     def _forge_row(updates_buf, malicious, sq, k_adv):
-        """Stats passes of a row-geometry forge -> the forged (d,) row
-        and the post-forge row squared norms."""
-        from blades_tpu.parallel.streamed_geometry import forge_streamed
+        """Fused stats bundles of a row-geometry forge -> the forged
+        (d,) row and the post-forge row squared norms.  ``sq`` may be
+        ``None`` (read-only buffer): the row-norm request fuses into the
+        forge's first bundle."""
+        from blades_tpu.parallel.streamed_geometry import (
+            PassPlanner,
+            forge_streamed,
+        )
 
-        forged = forge_streamed(
-            fr.adversary, updates_buf, malicious, sq, k_adv, agg,
-            min(d_chunk, updates_buf.shape[1]),
+        planner = PassPlanner(updates_buf, d_chunk, d=d_model,
+                              recorder=_pass_recorder, fuse=fuse_rowgeom)
+        forged, sq = forge_streamed(
+            fr.adversary, updates_buf, malicious, sq, k_adv, agg, planner,
         )
         sq = jnp.where(malicious, forged @ forged, sq)
         return forged, sq
@@ -511,9 +539,10 @@ def streamed_step(
     @partial(jax.jit, donate_argnums=(0,))
     def _scatter_chunk(updates_buf, forged, malicious, start):
         """Write the forged row's columns into the malicious lanes of one
-        chunk of the DONATED buffer (idempotent on the overlap tail)."""
-        n, d = updates_buf.shape
-        c = min(d_chunk, d)
+        chunk of the DONATED buffer (idempotent on the overlap tail;
+        padding columns past d_model are never touched)."""
+        n = updates_buf.shape[0]
+        c = min(d_chunk, d_model)
         fs = lax.dynamic_slice(forged, (start,), (c,))
         chunk = lax.dynamic_slice(updates_buf, (0, start), (n, c))
         chunk = jnp.where(malicious[:, None],
@@ -529,7 +558,8 @@ def streamed_step(
         from blades_tpu.parallel.streamed_geometry import aggregate_coordwise
 
         agg_vec = aggregate_coordwise(
-            agg, updates_buf, min(d_chunk, updates_buf.shape[1])
+            agg, updates_buf, min(d_chunk, d_model), d=d_model,
+            recorder=_pass_recorder,
         )
         return _serve_aggregate(server_state, agg_vec, malicious, losses,
                                 sq, bad_rows)
@@ -633,10 +663,21 @@ def streamed_step(
                    and malicious_prefix % client_block == 0
                    and kernel_applicable(nb, d_model))
         use_fused = use_fused or compact
-        # The fused pallas finish wants stripe-aligned columns; padding
+        # The fused pallas finishes want stripe-aligned columns; padding
         # at allocation (zero columns, sliced off the aggregate) avoids a
-        # whole-matrix pad copy inside the kernel call.
-        if use_fused:
+        # whole-matrix pad copy inside the kernel call.  The row-geometry
+        # path pads for the same reason whenever the fused row-stats
+        # kernel can serve its planner bundles (chunk traversals are
+        # bounded to d_model either way, so padding is inert on the
+        # fallback path).
+        pad_cols = use_fused
+        if row_geom or row_forges:
+            from blades_tpu.ops.pallas_rowstats import (
+                kernel_applicable as _rowstats_ok,
+            )
+
+            pad_cols = pad_cols or _rowstats_ok(n, d_model)
+        if pad_cols:
             from blades_tpu.ops.pallas_select import _BLOCK_D
 
             d_alloc = -(-d_model // _BLOCK_D) * _BLOCK_D
@@ -680,7 +721,10 @@ def streamed_step(
                         jnp.int32(min(i * c, d_model - c)),
                     )
             else:
-                sq = _rowgeom_sq(updates_buf)
+                # Read-only buffer: no dedicated row-norm traversal — the
+                # sq request fuses into the forge's/aggregator's first
+                # statistics bundle (sq=None threads through).
+                sq = None
                 bad = jnp.zeros((n,), bool)
             if row_forges:
                 # Stats passes -> forged (d,) row, then scatter it into
@@ -716,6 +760,21 @@ def streamed_step(
                 state.server, updates_buf, malicious, jnp.concatenate(losses),
                 jnp.concatenate(norms), k_adv, k_dp,
             )
+        if row_geom or row_forges:
+            # Pass-fusion telemetry (schema-registered, stamped host-side
+            # like elided_lanes): planned full-matrix HBM traversals this
+            # round — the fused plan vs the one-traversal-per-statistic
+            # baseline.  Planner counts fill at first trace; the fixed
+            # components are the materialization rewrite and the forged-
+            # row scatter, each one traversal.  Data-dependent Weiszfeld
+            # loops count maxiter iterations (a planned upper bound).
+            fixed_passes = ((1 if _rowgeom_rewrites else 0)
+                            + (1 if row_forges else 0))
+            metrics["hbm_passes"] = jnp.int32(
+                _pass_recorder.executed + fixed_passes)
+            metrics["hbm_passes_unfused"] = jnp.int32(
+                _pass_recorder.unfused + fixed_passes)
+            _pass_recorder.finalize()
         if skip_blocks:
             # Elision telemetry (schema-registered): lanes whose training
             # blocks were skipped this round — the lanes num_unhealthy can

@@ -1,41 +1,72 @@
-"""Row-geometry aggregation over the streamed ``(n, d)`` update buffer.
+"""Row-geometry aggregation over the streamed ``(n, d)`` update buffer —
+request/plan/execute pass fusion.
 
 The streamed single-chip round (:mod:`blades_tpu.parallel.streamed`)
-stores the giant update matrix once (bf16 by default) and originally
-covered only the coordinate-wise aggregators, whose columns are
-independent.  The rest of the defense suite needs ROW geometry — norms,
-pairwise distances, cosine matrices, projections — which a width chunk
-cannot see.  But every one of those reduces to a handful of FULL PASSES
-over the matrix accumulating small results:
+stores the giant update matrix once (bf16 by default).  The row-geometry
+defenses — GeoMed, Multikrum, DnC, Centeredclipping, Signguard,
+Clippedclustering, FLTrust — need full-matrix statistics a width chunk
+cannot see: row squared norms, the Gram matrix, dots against replicated
+vectors, per-row sign counts, weighted row sums.  Every one of them is a
+FULL HBM traversal of a ~10 GB matrix, and the traversal — not the
+arithmetic — is the cost: at n=1000 x d=4.9M one pass is ~12 ms of
+memory floor, and an aggregator that takes its statistics one primitive
+at a time pays that floor once per statistic.
 
-- row squared norms ``(n,)`` — one pass;
-- a Gram matrix ``(n, n)`` — one pass of chunk matmuls (the MXU eats
-  this: n^2 * d flops at ~25 ms for n=1000, d=4.9M);
-- dot products against a replicated ``(d,)`` vector — one pass;
-- weighted row sums ``(d,)`` — one pass;
-- per-row sign counts — one pass;
-- masked/row-scaled coordinate medians — one pass.
+This module therefore runs on a **request/plan/execute lifecycle**:
+
+1. **request** — an aggregator (or forge) declares the accumulators it
+   needs *at the same point of its dataflow* by calling request methods
+   on a :class:`PassPlanner` (``sq_norms()``, ``gram()``, ``dots(v)``,
+   ``weighted_sum(w)``, ``gram_dot(w)``, ``sign_counts()``, ...).  Each
+   request returns a :class:`PassHandle` whose ``.value`` is filled at
+   execute time.
+2. **plan** — ``execute()`` batches every pending request into ONE chunk
+   traversal (or one per request with ``fuse=False`` — the A/B
+   comparator).  Requests are fusable whenever no request's input
+   depends on another pending request's output; the aggregator
+   implementations below are written so every such opportunity is taken
+   (Multikrum fuses norms+Gram, SignGuard norms+sign-counts, each
+   Weiszfeld/clip iteration fuses its weighted-row-sum with the
+   Gram-vector product that yields the NEXT iterate's distances).
+3. **execute** — the bundle runs either as one ``lax.scan`` over column
+   chunks (the portable fallback, exactly the pre-fusion chunk math) or,
+   when :func:`blades_tpu.ops.pallas_rowstats.kernel_applicable` says
+   so, as the fused pallas kernel: one HBM read per stripe serving the
+   whole bundle.  A :class:`PassRecorder` counts planned traversals —
+   executed (fused) vs what one-traversal-per-request would have run —
+   surfaced per round as the ``hbm_passes`` metrics.
+
+Reassociation: fused chunk-loop results are bit-identical to the
+unfused chunk path (same chunk values, same per-request updaters).  The
+Weiszfeld/clip iterations derive distances through the Gram identity
+``buf @ wavg(w) = (buf buf^T) w / sum(w)`` instead of a dedicated dots
+pass, and the pallas kernel reduces per stripe — both reassociate f32
+reductions, so equivalence against the dense implementations holds to
+the same tolerances the chunk path has always carried
+(tests/test_streamed_geometry.py, tests/test_pass_fusion.py).
 
 Row-norm clipping never rewrites the matrix: clipping scales whole rows,
 so every aggregator is re-expressed against per-row SCALES applied
-inside the passes.  On these primitives the full suite runs single-chip
-at the 1000-client scale: GeoMed (Weiszfeld over distance passes),
-Multikrum (Gram -> scores -> masked mean), DnC (column gather -> SVD),
-Centeredclipping (clip-to-center passes, momentum state), Signguard
-(norm band + sign-feature k-means), Clippedclustering (norm history +
-cosine clustering), FLTrust (trusted-row cosine weights).  Each mirrors
-the dense implementation in :mod:`blades_tpu.ops.aggregators` — same
-constants, same selection logic, same empty-mask degradation — with
-reductions reassociated over chunks (equivalence tests use tolerances).
+inside the passes.  Each implementation mirrors the dense one in
+:mod:`blades_tpu.ops.aggregators` — same constants, same selection
+logic, same empty-mask degradation.
 
 Chunks follow the streamed finish's scheme: fixed width ``c``, starts
 ``min(i*c, d - c)`` (the tail chunk overlaps; accumulating passes mask
-already-covered columns, idempotent writes just overwrite).
+already-covered columns via :func:`new_cols`, idempotent writes just
+overwrite — the invariant tests/test_pass_fusion.py property-tests).
+
+The raw single-statistic traversal primitives (:func:`row_sq_norms`,
+:func:`gram`, ...) remain as the reference implementations, but calling
+them from OUTSIDE this module is a lint error
+(``streamed-pass-discipline``): a direct call is a full HBM traversal
+the planner can no longer fuse.
 """
 
 from __future__ import annotations
 
-from typing import Any, Optional, Tuple
+import contextlib
+from typing import Any, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -112,14 +143,333 @@ def check_applicable(agg, n: int) -> None:
             )
 
 
-def _pass(buf: jax.Array, c: int, init, f):
+# ---------------------------------------------------------------------------
+# pass accounting
+# ---------------------------------------------------------------------------
+
+
+class PassRecorder:
+    """Trace-time HBM-traversal accounting for one streamed step.
+
+    ``executed`` counts the full-matrix traversals the fused plan runs;
+    ``unfused`` what a one-traversal-per-accumulator-request path would
+    have run (the pre-fusion baseline the ``hbm_passes`` regression test
+    pins).  Data-dependent loops (GeoMed's Weiszfeld ``while_loop``)
+    count their per-iteration cost times the loop's static iteration
+    bound (``PassPlanner.loop``) — a *planned* upper bound, since the
+    actual iteration count is decided on device.  Counts accrue at trace
+    time only and are frozen by :meth:`finalize` after the first round
+    stamps them, so shape-driven retraces cannot double-count.
+    """
+
+    def __init__(self):
+        self.executed = 0
+        self.unfused = 0
+        self._final = False
+
+    def count(self, executed: int, unfused: int, mult: int = 1) -> None:
+        if not self._final:
+            self.executed += executed * mult
+            self.unfused += unfused * mult
+
+    def finalize(self) -> None:
+        self._final = True
+
+
+class PassHandle:
+    """The future a request returns: ``.value`` is the accumulator's
+    result after the planner's next ``execute()``."""
+
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = None
+
+
+class _Req:
+    __slots__ = ("kind", "handle", "kw")
+
+    def __init__(self, kind: str, handle: PassHandle, **kw):
+        self.kind = kind
+        self.handle = handle
+        self.kw = kw
+
+
+# Request kinds the fused pallas kernel can serve; anything else in a
+# bundle routes the whole bundle through the chunk loop (still ONE
+# traversal — a kernel+chunk split would read the matrix twice).
+_KERNEL_KINDS = frozenset({"sq", "gram", "signs", "dots", "wsum", "gram_dot"})
+
+
+class PassPlanner:
+    """Batch accumulator requests into single chunk traversals.
+
+    Args:
+        buf: ``(n, d_alloc)`` update matrix in storage dtype.  Columns
+            past ``d`` are stripe-alignment padding (zeros) the planner
+            never reads on the chunk path and the kernel reads harmlessly.
+        c: chunk width for the ``lax.scan`` fallback.
+        d: true model width (default: ``buf.shape[1]``).
+        recorder: optional :class:`PassRecorder`.
+        fuse: ``False`` runs one traversal per request — the unfused
+            comparator for A/B benches and equivalence tests.
+        use_kernel: ``None`` auto-gates on
+            :func:`blades_tpu.ops.pallas_rowstats.kernel_applicable`;
+            ``True`` forces the kernel (tests drive it in interpret
+            mode); ``False`` forces the chunk loop.
+        interpret: run the kernel in pallas interpret mode (tests).
+    """
+
+    def __init__(self, buf: jax.Array, c: int, *, d: Optional[int] = None,
+                 recorder: Optional[PassRecorder] = None, fuse: bool = True,
+                 use_kernel: Optional[bool] = None, interpret: bool = False):
+        self.buf = buf
+        self.n = buf.shape[0]
+        self.d = int(d) if d is not None else buf.shape[1]
+        self.c = min(int(c), self.d)
+        self.recorder = recorder
+        self.fuse = fuse
+        self.use_kernel = use_kernel
+        self.interpret = interpret
+        self._pending: List[_Req] = []
+        self._mult = 1
+
+    # -- requests -----------------------------------------------------------
+
+    def _req(self, kind: str, **kw) -> PassHandle:
+        h = PassHandle()
+        self._pending.append(_Req(kind, h, **kw))
+        return h
+
+    def sq_norms(self) -> PassHandle:
+        """Row squared norms ``(n,)`` f32."""
+        return self._req("sq")
+
+    def gram(self) -> PassHandle:
+        """``buf @ buf.T`` ``(n, n)`` f32."""
+        return self._req("gram")
+
+    def sign_counts(self) -> PassHandle:
+        """Per-row (pos, neg, zero) coordinate counts ``(n, 3)`` f32,
+        over the true ``d`` columns."""
+        return self._req("signs")
+
+    def dots(self, v: jax.Array) -> PassHandle:
+        """``buf @ v`` ``(n,)`` for a replicated ``(d,)`` vector."""
+        return self._req("dots", v=v)
+
+    def weighted_sum(self, w: jax.Array) -> PassHandle:
+        """``w @ buf`` ``(d,)`` — weighted row sum (w includes any row
+        scale).  Overwrite-idempotent on the overlap tail."""
+        return self._req("wsum", w=w)
+
+    def gram_dot(self, w: jax.Array) -> PassHandle:
+        """``(buf @ buf.T) @ w`` ``(n,)`` WITHOUT materializing the Gram:
+        per chunk ``C_new @ (C.T @ w)``.  The fusion lever for iterative
+        centers: ``buf @ wavg(w) = gram_dot(w) / w.sum()``, so the pass
+        producing iterate k's center also yields every distance to it."""
+        return self._req("gram_dot", w=w)
+
+    def gather(self, idx: jax.Array) -> PassHandle:
+        """``buf[:, idx]`` ``(n, m)`` f32 without a giant-matrix copy
+        (chunk path only — each pass gathers from the in-flight slice)."""
+        return self._req("gather", idx=idx)
+
+    def col_mean_std(self, malicious: jax.Array) -> PassHandle:
+        """Benign per-coordinate mean and ddof=1 std, ``((d,), (d,))``
+        f32 — the forge statistics (chunk path only)."""
+        return self._req("mean_std", malicious=malicious)
+
+    def masked_median(self, mask: jax.Array, row_scale: jax.Array) -> PassHandle:
+        """Coordinate-wise median over selected rows of
+        ``buf * row_scale`` ``(d,)`` (chunk path only)."""
+        return self._req("masked_median", mask=mask, row_scale=row_scale)
+
+    def coordwise(self, agg) -> PassHandle:
+        """Mean/Median/Trimmedmean over the buffer chunk by chunk (the
+        aggregator's own per-chunk fast paths apply) — used when a
+        row-geometry forger already materialized the attack, so the
+        coordinate-wise finish has no forging left to fuse."""
+        return self._req("coordwise", agg=agg)
+
+    # -- plan / execute -----------------------------------------------------
+
+    @contextlib.contextmanager
+    def loop(self, iterations: int):
+        """Multiply recorder counts for bundles executed inside a traced
+        loop body (``lax.while_loop`` / ``fori_loop`` trace once; the
+        body's traversals run ``iterations`` times at runtime)."""
+        prev = self._mult
+        self._mult = prev * int(iterations)
+        try:
+            yield
+        finally:
+            self._mult = prev
+
+    def execute(self) -> None:
+        """Run every pending request — ONE traversal when fused."""
+        reqs, self._pending = self._pending, []
+        if not reqs:
+            return
+        groups = [reqs] if self.fuse else [[r] for r in reqs]
+        for group in groups:
+            if self._kernel_ok(group):
+                self._run_kernel(group)
+            else:
+                self._run_chunked(group)
+        if self.recorder is not None:
+            self.recorder.count(len(groups), len(reqs), self._mult)
+
+    def _kernel_ok(self, reqs) -> bool:
+        if self.use_kernel is False:
+            return False
+        kinds = {r.kind for r in reqs}
+        if not kinds <= _KERNEL_KINDS:
+            return False
+        if self.use_kernel:
+            return True
+        from blades_tpu.ops import pallas_rowstats
+
+        return pallas_rowstats.kernel_applicable(
+            self.n, self.d, gram="gram" in kinds)
+
+    def _run_kernel(self, reqs) -> None:
+        from blades_tpu.ops import pallas_rowstats
+
+        kinds = {r.kind for r in reqs}
+        dots_v = [r.kw["v"] for r in reqs if r.kind == "dots"]
+        ws = [r.kw["w"] for r in reqs if r.kind == "wsum"]
+        gds = [r.kw["w"] for r in reqs if r.kind == "gram_dot"]
+        out = pallas_rowstats.row_stats_bundle(
+            self.buf,
+            sq="sq" in kinds,
+            gram="gram" in kinds,
+            signs="signs" in kinds,
+            dots=jnp.stack(dots_v) if dots_v else None,
+            weights=jnp.stack(ws) if ws else None,
+            gram_dot=jnp.stack(gds) if gds else None,
+            d_true=self.d,
+            interpret=self.interpret,
+        )
+        di = wi = gi = 0
+        for r in reqs:
+            if r.kind == "sq":
+                r.handle.value = out["sq"]
+            elif r.kind == "gram":
+                r.handle.value = out["gram"]
+            elif r.kind == "signs":
+                r.handle.value = out["signs"]
+            elif r.kind == "dots":
+                r.handle.value = out["dots"][:, di]
+                di += 1
+            elif r.kind == "wsum":
+                r.handle.value = out["wsum"][wi]
+                wi += 1
+            else:
+                r.handle.value = out["gram_dot"][:, gi]
+                gi += 1
+
+    def _run_chunked(self, reqs) -> None:
+        inits = tuple(self._init(r) for r in reqs)
+
+        def f(carry, chunk, start, new):
+            return tuple(
+                self._update(r, acc, chunk, start, new)
+                for r, acc in zip(reqs, carry)
+            )
+
+        out = _pass(self.buf, self.c, inits, f, d=self.d)
+        for r, acc in zip(reqs, out):
+            r.handle.value = acc
+
+    # per-kind accumulator init/update — the reference chunk math every
+    # fused traversal is built from (and the kernel is tested against).
+
+    def _init(self, r: _Req):
+        n, d = self.n, self.d
+        if r.kind == "sq":
+            return jnp.zeros((n,), jnp.float32)
+        if r.kind == "gram":
+            return jnp.zeros((n, n), jnp.float32)
+        if r.kind == "signs":
+            return jnp.zeros((n, 3), jnp.float32)
+        if r.kind in ("dots", "gram_dot"):
+            return jnp.zeros((n,), jnp.float32)
+        if r.kind in ("wsum", "masked_median", "coordwise"):
+            return jnp.zeros((d,), jnp.float32)
+        if r.kind == "gather":
+            return jnp.zeros((n, r.kw["idx"].shape[0]), jnp.float32)
+        if r.kind == "mean_std":
+            return (jnp.zeros((d,), jnp.float32), jnp.zeros((d,), jnp.float32))
+        raise ValueError(f"unknown request kind {r.kind!r}")
+
+    def _update(self, r: _Req, acc, chunk, start, new):
+        kind = r.kind
+        if kind == "sq":
+            return acc + jnp.where(new[None, :], chunk * chunk, 0.0).sum(axis=1)
+        if kind == "gram":
+            return acc + jnp.where(new[None, :], chunk, 0.0) @ chunk.T
+        if kind == "signs":
+            m = new[None, :]
+            return acc + jnp.stack(
+                [
+                    ((chunk > 0) & m).sum(axis=1),
+                    ((chunk < 0) & m).sum(axis=1),
+                    ((chunk == 0) & m).sum(axis=1),
+                ],
+                axis=1,
+            ).astype(jnp.float32)
+        if kind == "dots":
+            vc = lax.dynamic_slice(r.kw["v"], (start,), (chunk.shape[1],))
+            return acc + chunk @ jnp.where(new, vc, 0.0)
+        if kind == "wsum":
+            # Overlap writes are identical — overwrite is idempotent.
+            return lax.dynamic_update_slice(acc, r.kw["w"] @ chunk, (start,))
+        if kind == "gram_dot":
+            # G @ w = sum_chunks C_new @ (C^T w): the full chunk feeds the
+            # inner product, the coverage mask dedups the outer one.
+            t = chunk.T @ r.kw["w"]
+            return acc + jnp.where(new[None, :], chunk, 0.0) @ t
+        if kind == "gather":
+            # Chunks arrive in order; an in-range column overwrites with
+            # the identical value, so no coverage mask.
+            idx = r.kw["idx"]
+            pos = idx - start
+            inside = (pos >= 0) & (pos < chunk.shape[1])
+            vals = jnp.take(chunk, jnp.clip(pos, 0, chunk.shape[1] - 1), axis=1)
+            return jnp.where(inside[None, :], vals, acc)
+        if kind == "mean_std":
+            # Same formulas as adversaries.base.benign_mean_std (ddof=1).
+            mean_acc, std_acc = acc
+            w = jnp.where(r.kw["malicious"], 0.0, 1.0).astype(jnp.float32)
+            nb = jnp.maximum(w.sum(), 1.0)
+            m = (chunk * w[:, None]).sum(axis=0) / nb
+            v = ((chunk - m) ** 2 * w[:, None]).sum(axis=0) \
+                / jnp.maximum(nb - 1.0, 1.0)
+            return (
+                lax.dynamic_update_slice(mean_acc, m, (start,)),
+                lax.dynamic_update_slice(std_acc, jnp.sqrt(v), (start,)),
+            )
+        if kind == "masked_median":
+            med = masked.masked_median(
+                chunk * r.kw["row_scale"][:, None], r.kw["mask"])
+            return lax.dynamic_update_slice(acc, med, (start,))
+        if kind == "coordwise":
+            return lax.dynamic_update_slice(
+                acc, r.kw["agg"].aggregate(chunk), (start,))
+        raise ValueError(f"unknown request kind {kind!r}")
+
+
+def _pass(buf: jax.Array, c: int, init, f, d: Optional[int] = None):
     """Scan column chunks; ``f(carry, chunk_f32, start, new_mask) -> carry``.
 
     ``new_mask`` (c,) marks columns not covered by earlier chunks (the
-    tail chunk overlaps) — accumulators must weight by it.
+    tail chunk overlaps) — accumulators must weight by it.  ``d`` bounds
+    the traversal to the true model width when ``buf`` carries
+    stripe-alignment padding columns.
     """
-    n, d = buf.shape
-    c, k, starts = chunk_grid(d, c)
+    n = buf.shape[0]
+    c, k, starts = chunk_grid(buf.shape[1] if d is None else d, c)
 
     def body(carry, inp):
         i, start = inp
@@ -130,74 +480,43 @@ def _pass(buf: jax.Array, c: int, init, f):
     return carry
 
 
+def _single(buf, c, kind, d=None, **kw):
+    """One-request planner run — the reference primitives below."""
+    p = PassPlanner(buf, c, d=d)
+    h = p._req(kind, **kw)
+    p.execute()
+    return h.value
+
+
+# ---------------------------------------------------------------------------
+# raw traversal primitives (reference implementations).  Calling these
+# from outside this module is a `streamed-pass-discipline` lint error:
+# each call is a full HBM traversal the planner can no longer fuse.
+# ---------------------------------------------------------------------------
+
+
 def row_sq_norms(buf: jax.Array, c: int) -> jax.Array:
-    return _pass(
-        buf, c, jnp.zeros((buf.shape[0],), jnp.float32),
-        lambda acc, chunk, start, new:
-            acc + jnp.where(new[None, :], chunk * chunk, 0.0).sum(axis=1),
-    )
+    return _single(buf, c, "sq")
 
 
 def gram(buf: jax.Array, c: int) -> jax.Array:
     """``buf @ buf.T`` (n, n) in f32."""
-    n = buf.shape[0]
-    return _pass(
-        buf, c, jnp.zeros((n, n), jnp.float32),
-        lambda acc, chunk, start, new:
-            acc + jnp.where(new[None, :], chunk, 0.0) @ chunk.T,
-    )
+    return _single(buf, c, "gram")
 
 
 def row_dots(buf: jax.Array, v: jax.Array, c: int) -> jax.Array:
     """``buf @ v`` (n,) for a replicated ``(d,)`` vector."""
-
-    def f(acc, chunk, start, new):
-        vc = lax.dynamic_slice(v, (start,), (chunk.shape[1],))
-        return acc + chunk @ jnp.where(new, vc, 0.0)
-
-    return _pass(buf, c, jnp.zeros((buf.shape[0],), jnp.float32), f)
-
-
-def row_dots2(buf: jax.Array, v1: jax.Array, v2: jax.Array, c: int):
-    """``(buf @ v1, buf @ v2)`` in ONE pass over the matrix (the giant
-    read dominates; MinMax needs both mean- and deviation-dots)."""
-
-    def f(acc, chunk, start, new):
-        a1, a2 = acc
-        w = chunk.shape[1]
-        m1 = jnp.where(new, lax.dynamic_slice(v1, (start,), (w,)), 0.0)
-        m2 = jnp.where(new, lax.dynamic_slice(v2, (start,), (w,)), 0.0)
-        return a1 + chunk @ m1, a2 + chunk @ m2
-
-    z = jnp.zeros((buf.shape[0],), jnp.float32)
-    return _pass(buf, c, (z, z), f)
+    return _single(buf, c, "dots", v=v)
 
 
 def weighted_row_sum(buf: jax.Array, w: jax.Array, c: int) -> jax.Array:
     """``w @ buf`` (d,) — weighted sum of rows (w includes any row scale)."""
-
-    def f(acc, chunk, start, new):
-        del new  # overlap writes are identical — overwrite is idempotent
-        return lax.dynamic_update_slice(acc, w @ chunk, (start,))
-
-    return _pass(buf, c, jnp.zeros((buf.shape[1],), jnp.float32), f)
+    return _single(buf, c, "wsum", w=w)
 
 
 def sign_counts(buf: jax.Array, c: int) -> jax.Array:
     """Per-row (pos, neg, zero) coordinate counts (n, 3), f32."""
-
-    def f(acc, chunk, start, new):
-        m = new[None, :]
-        return acc + jnp.stack(
-            [
-                ((chunk > 0) & m).sum(axis=1),
-                ((chunk < 0) & m).sum(axis=1),
-                ((chunk == 0) & m).sum(axis=1),
-            ],
-            axis=1,
-        ).astype(jnp.float32)
-
-    return _pass(buf, c, jnp.zeros((buf.shape[0], 3), jnp.float32), f)
+    return _single(buf, c, "signs")
 
 
 def gather_columns(buf: jax.Array, idx: jax.Array, c: int) -> jax.Array:
@@ -205,77 +524,60 @@ def gather_columns(buf: jax.Array, idx: jax.Array, c: int) -> jax.Array:
 
     A direct fancy-gather on the stored ``(n, d)`` matrix makes XLA
     materialize a full copy of it (OOM at giant scale); instead each
-    chunk pass gathers from the small in-flight ``(n, c)`` slice and
-    keeps the columns whose global index lands in this chunk's
-    not-yet-covered region.
+    chunk pass gathers from the small in-flight ``(n, c)`` slice.
     """
-    m = idx.shape[0]
-
-    def f(acc, chunk, start, new):
-        # Overlapping tail: chunks arrive in order and an in-range column
-        # just overwrites with the identical value, so no coverage mask.
-        del new
-        pos = idx - start
-        inside = (pos >= 0) & (pos < chunk.shape[1])
-        vals = jnp.take(chunk, jnp.clip(pos, 0, chunk.shape[1] - 1), axis=1)
-        return jnp.where(inside[None, :], vals, acc)
-
-    return _pass(buf, c, jnp.zeros((buf.shape[0], m), jnp.float32), f)
+    return _single(buf, c, "gather", idx=idx)
 
 
 def benign_col_mean_std(buf: jax.Array, malicious: jax.Array, c: int):
     """Per-coordinate mean and ddof=1 std over benign rows, materialized
     as ``(d,)`` f32 vectors (one pass; same formulas as
     :func:`blades_tpu.adversaries.base.benign_mean_std`)."""
-    w = jnp.where(malicious, 0.0, 1.0).astype(jnp.float32)
-    nb = jnp.maximum(w.sum(), 1.0)
-
-    def f(acc, chunk, start, new):
-        del new
-        mean_acc, std_acc = acc
-        m = (chunk * w[:, None]).sum(axis=0) / nb
-        v = ((chunk - m) ** 2 * w[:, None]).sum(axis=0) / jnp.maximum(nb - 1.0, 1.0)
-        return (
-            lax.dynamic_update_slice(mean_acc, m, (start,)),
-            lax.dynamic_update_slice(std_acc, jnp.sqrt(v), (start,)),
-        )
-
-    d = buf.shape[1]
-    return _pass(buf, c,
-                 (jnp.zeros((d,), jnp.float32), jnp.zeros((d,), jnp.float32)),
-                 f)
+    return _single(buf, c, "mean_std", malicious=malicious)
 
 
-def aggregate_coordwise(agg, buf: jax.Array, c: int) -> jax.Array:
+def masked_scaled_median(buf, mask, row_scale, c) -> jax.Array:
+    """Coordinate-wise median over selected rows of ``buf * row_scale``."""
+    return _single(buf, c, "masked_median", mask=mask, row_scale=row_scale)
+
+
+def aggregate_coordwise(agg, buf: jax.Array, c: int, *,
+                        d: Optional[int] = None,
+                        recorder: Optional[PassRecorder] = None) -> jax.Array:
     """Mean/Median/Trimmedmean over the streamed buffer, chunk by chunk
     (the aggregator's own fast paths — pallas rank select on TPU — apply
     per chunk).  Used when a row-geometry FORGER already materialized the
     attack into the buffer, so the coordinate-wise finish has no forging
-    left to fuse."""
-
-    def f(acc, chunk, start, new):
-        del new
-        return lax.dynamic_update_slice(acc, agg.aggregate(chunk), (start,))
-
-    return _pass(buf, c, jnp.zeros((buf.shape[1],), jnp.float32), f)
+    left to fuse.  A sanctioned single-traversal entry point (counted,
+    not a raw primitive)."""
+    p = PassPlanner(buf, c, d=d, recorder=recorder)
+    h = p.coordwise(agg)
+    p.execute()
+    return h.value
 
 
 # ---------------------------------------------------------------------------
-# row-geometry forgers: stats passes -> one forged (d,) row
+# row-geometry forgers: fused stats bundles -> one forged (d,) row
 # ---------------------------------------------------------------------------
 
 
-def forge_streamed(adv, buf, malicious, sq, key, aggregator, c) -> jax.Array:
+def forge_streamed(adv, buf, malicious, sq, key, aggregator,
+                   planner: PassPlanner) -> Tuple[jax.Array, jax.Array]:
     """Compute the forged ``(d,)`` row of a row-geometry attack against
     the streamed buffer (the caller scatters it into malicious lanes).
 
     Mirrors the dense ``on_updates_ready`` implementations
     (adversaries/update_attacks.py) with the matrix geometry re-expressed
-    as passes: pairwise distances from one Gram pass, distances to the
-    forged candidate from precomputed dots (MinMax's bisection becomes
-    scalar algebra), cosine geometry from the same Gram (ACC).  Keyed
-    draws (SignGuard-attack) use the round key over the full width, so
-    they match the dense round's draws exactly.
+    as fused planner bundles: MinMax takes its benign mean/std, the Gram
+    matrix and (when not precomputed) the row norms in ONE traversal and
+    its candidate-distance dots in a second; ACC likewise.  Keyed draws
+    (SignGuard-attack) use the round key over the full width, so they
+    match the dense round's draws exactly.
+
+    ``sq`` may be ``None`` — the row-norm request then fuses into the
+    forge's first bundle.  Returns ``(forged row, post-pass sq)`` with
+    ``sq`` NOT yet reflecting the forged rows (the caller rewrites
+    malicious entries after scattering).
     """
     from blades_tpu.adversaries.update_attacks import (
         AttackclippedclusteringAdversary,
@@ -285,19 +587,29 @@ def forge_streamed(adv, buf, malicious, sq, key, aggregator, c) -> jax.Array:
     )
     from blades_tpu.ops.aggregators import Signguard as SignguardAgg
 
+    pl_ = planner
     n = buf.shape[0]
     benign = ~malicious
     w = benign.astype(jnp.float32)
 
     if isinstance(adv, MinMaxAdversary):
-        mean, dev = benign_col_mean_std(buf, malicious, c)
+        h_sq = pl_.sq_norms() if sq is None else None
+        h_ms = pl_.col_mean_std(malicious)
+        h_g = pl_.gram()
+        pl_.execute()
+        if h_sq is not None:
+            sq = h_sq.value
+        mean, dev = h_ms.value
         if isinstance(aggregator, SignguardAgg):
             dev = _negate_first_half(dev)
-        g = gram(buf, c)
+        g = h_g.value
         d2 = jnp.maximum(sq[:, None] + sq[None, :] - 2.0 * g, 0.0)
         pair_ok = w[:, None] * w[None, :]
         threshold = jnp.sqrt(jnp.maximum((d2 * pair_ok).max(), 0.0))
-        dots_mean, dots_dev = row_dots2(buf, mean, dev, c)
+        h_dm = pl_.dots(mean)
+        h_dv = pl_.dots(dev)
+        pl_.execute()
+        dots_mean, dots_dev = h_dm.value, h_dv.value
         mm, md, dd = mean @ mean, mean @ dev, dev @ dev
 
         def max_dist_to_benign(gamma):
@@ -316,23 +628,36 @@ def forge_streamed(adv, buf, malicious, sq, key, aggregator, c) -> jax.Array:
         lo, hi = lax.fori_loop(0, adv.iters, body,
                                (jnp.zeros(()), jnp.full((), 5.0)))
         gamma = (lo + hi) / 2.0
-        return mean - gamma * dev
+        return mean - gamma * dev, sq
 
     if isinstance(adv, SignGuardAdversary):
-        mean, _ = benign_col_mean_std(buf, malicious, c)
+        h_sq = pl_.sq_norms() if sq is None else None
+        h_ms = pl_.col_mean_std(malicious)
+        pl_.execute()
+        if h_sq is not None:
+            sq = h_sq.value
+        mean, _ = h_ms.value
         d = mean.shape[0]
         pos = (mean > 0).sum()
         neg = (mean < 0).sum()
         k_perm, k_mag = jax.random.split(key)
         rank = jax.random.permutation(k_perm, d)
         u = jax.random.uniform(k_mag, (d,), jnp.float32)
-        return jnp.where(rank < pos, u, jnp.where(rank < pos + neg, -u, 0.0))
+        forged = jnp.where(rank < pos, u,
+                           jnp.where(rank < pos + neg, -u, 0.0))
+        return forged, sq
 
     if isinstance(adv, AttackclippedclusteringAdversary):
-        mean, _ = benign_col_mean_std(buf, malicious, c)
+        h_sq = pl_.sq_norms() if sq is None else None
+        h_ms = pl_.col_mean_std(malicious)
+        h_g = pl_.gram()
+        pl_.execute()
+        if h_sq is not None:
+            sq = h_sq.value
+        mean, _ = h_ms.value
         norms = jnp.sqrt(jnp.maximum(sq, 0.0))
         q = 1.0 / jnp.maximum(norms, 1e-12)
-        cos = jnp.clip(q[:, None] * q[None, :] * gram(buf, c), -1.0, 1.0)
+        cos = jnp.clip(q[:, None] * q[None, :] * h_g.value, -1.0, 1.0)
         dist = 1.0 - cos
         eye = jnp.eye(n, dtype=bool)
         pair_ok = (w[:, None] * w[None, :] > 0) & ~eye
@@ -343,14 +668,16 @@ def forge_streamed(adv, buf, malicious, sq, key, aggregator, c) -> jax.Array:
             big_dist, linkage="single") & benign
         mean_norm = jnp.linalg.norm(mean)
         mean_hat = mean / jnp.maximum(mean_norm, 1e-12)
-        cos2mean = row_dots(buf, mean_hat, c) * q
+        h_c2m = pl_.dots(mean_hat)
+        pl_.execute()
+        cos2mean = h_c2m.value * q
         dis2mean = jnp.where(majority, 1.0 - cos2mean, -jnp.inf)
         idx = jnp.argmax(dis2mean)
         theta = jnp.arccos(jnp.clip(1.0 - dis2mean[idx], -1.0, 1.0))
         theta = jnp.maximum(theta, 1e-3)
         u_star = (
-            lax.dynamic_slice_in_dim(buf, idx, 1, axis=0)[0].astype(jnp.float32)
-            * q[idx]
+            lax.dynamic_slice_in_dim(buf, idx, 1, axis=0)[0, :mean.shape[0]]
+            .astype(jnp.float32) * q[idx]
         )
         ang = theta + theta_cross - adv.eps
         a = jnp.cos(ang) - jnp.sin(ang) / jnp.tan(theta)
@@ -358,22 +685,11 @@ def forge_streamed(adv, buf, malicious, sq, key, aggregator, c) -> jax.Array:
              + jnp.sin(theta_cross - adv.eps) / jnp.tan(theta))
         rotated = 10.0 * (a * mean_hat + b * u_star)
         fallback = -10.0 * mean
-        return jnp.where(theta + theta_cross >= jnp.pi, fallback, rotated)
+        return jnp.where(theta + theta_cross >= jnp.pi, fallback, rotated), sq
 
     raise NotImplementedError(
         f"no streamed forge for {type(adv).__name__}"
     )
-
-
-def masked_scaled_median(buf, mask, row_scale, c) -> jax.Array:
-    """Coordinate-wise median over selected rows of ``buf * row_scale``."""
-
-    def f(acc, chunk, start, new):
-        del new
-        med = masked.masked_median(chunk * row_scale[:, None], mask)
-        return lax.dynamic_update_slice(acc, med, (start,))
-
-    return _pass(buf, c, jnp.zeros((buf.shape[1],), jnp.float32), f)
 
 
 def _masked_mean_w(mask: jax.Array, row_scale: jax.Array) -> jax.Array:
@@ -384,71 +700,95 @@ def _masked_mean_w(mask: jax.Array, row_scale: jax.Array) -> jax.Array:
 
 
 # ---------------------------------------------------------------------------
-# aggregator implementations
+# aggregator implementations (request/plan/execute per the module
+# docstring; each returns (aggregate, sq) with sq passed through or
+# computed fused into the first statistics bundle)
 # ---------------------------------------------------------------------------
 
 
-def _geomed(agg: GeoMed, buf, sq, c):
-    n = buf.shape[0]
+def _geomed(agg: GeoMed, pl_: PassPlanner, sq):
+    n = pl_.n
     w0 = jnp.ones((n,), jnp.float32) / n
 
-    def wavg(w):
-        return weighted_row_sum(buf, w, c) / w.sum()
+    # One fused traversal per iterate: the weighted row sum that IS the
+    # new median and the gram_dot whose algebra yields every distance to
+    # it — buf @ wavg(w) = gram_dot(w)/W and ||wavg(w)||^2 = w·gram_dot(w)/W^2.
+    h_sq = pl_.sq_norms() if sq is None else None
+    h_m0 = pl_.weighted_sum(w0)
+    h_gd0 = pl_.gram_dot(w0)
+    pl_.execute()
+    if h_sq is not None:
+        sq = h_sq.value
 
-    def dists(m, mm):
-        d2 = sq - 2.0 * row_dots(buf, m, c) + mm
-        return jnp.sqrt(jnp.maximum(d2, 0.0))
+    def derive(m_raw, gd, w):
+        W = w.sum()
+        median = m_raw / W
+        d2 = sq - 2.0 * gd / W + (w @ gd) / (W * W)
+        dists = jnp.sqrt(jnp.maximum(d2, 0.0))
+        obj = (dists * w0).sum() / w0.sum()
+        return median, dists, obj
 
-    def obj_of(m):
-        return (dists(m, m @ m) * w0).sum() / w0.sum()
-
-    median0 = wavg(w0)
+    median0, dists0, obj0 = derive(h_m0.value, h_gd0.value, w0)
 
     def cond(carry):
-        i, _, prev_obj, cur_obj = carry
+        i, _, _, prev_obj, cur_obj = carry
         return (i < agg.maxiter) & (jnp.abs(prev_obj - cur_obj) > agg.ftol * cur_obj)
 
     def body(carry):
-        i, median, _, cur_obj = carry
-        denom = jnp.maximum(dists(median, median @ median), agg.eps)
-        new_median = wavg(w0 / denom)
-        return i + 1, new_median, cur_obj, obj_of(new_median)
+        i, median, dists, _, cur_obj = carry
+        w_k = w0 / jnp.maximum(dists, agg.eps)
+        h_m = pl_.weighted_sum(w_k)
+        h_gd = pl_.gram_dot(w_k)
+        pl_.execute()
+        new_median, new_dists, new_obj = derive(h_m.value, h_gd.value, w_k)
+        return i + 1, new_median, new_dists, cur_obj, new_obj
 
-    _, median, _, _ = lax.while_loop(
-        cond, body, (0, median0, jnp.inf, obj_of(median0))
-    )
-    return median
+    with pl_.loop(agg.maxiter):
+        _, median, _, _, _ = lax.while_loop(
+            cond, body, (0, median0, dists0, jnp.inf, obj0)
+        )
+    return median, sq
 
 
-def _multikrum(agg: Multikrum, buf, sq, c):
-    n = buf.shape[0]
+def _multikrum(agg: Multikrum, pl_: PassPlanner, sq):
+    n = pl_.n
     f = agg.num_byzantine
     check_applicable(agg, n)
-    g = gram(buf, c)
-    d2 = sq[:, None] + sq[None, :] - 2.0 * g
+    h_sq = pl_.sq_norms() if sq is None else None
+    h_g = pl_.gram()
+    pl_.execute()  # norms + Gram: ONE statistics traversal
+    if h_sq is not None:
+        sq = h_sq.value
+    d2 = sq[:, None] + sq[None, :] - 2.0 * h_g.value
     d2 = jnp.maximum(d2, 0.0)
     d2 = jnp.where(jnp.eye(n, dtype=bool), jnp.inf, d2)
     nearest = jnp.sort(d2, axis=1)[:, : n - f - 2]
     rank = jnp.argsort(jnp.argsort(nearest.sum(axis=1)))
     mask = rank < agg.k
-    return weighted_row_sum(buf, _masked_mean_w(mask, jnp.ones_like(sq)), c)
+    h_out = pl_.weighted_sum(_masked_mean_w(mask, jnp.ones_like(sq)))
+    pl_.execute()
+    return h_out.value, sq
 
 
-def _dnc(agg: DnC, buf, sq, c, key):
-    del sq
+def _dnc(agg: DnC, pl_: PassPlanner, sq, key):
     if key is None:
         raise ValueError("DnC requires a PRNG key (pass key= per round)")
-    n, d = buf.shape
+    n, d = pl_.n, pl_.d
     sub_dim = min(agg.sub_dim, d)
     check_applicable(agg, n)
     keep = n - int(agg.filter_frac * agg.num_byzantine)
 
     # Same per-iteration draws as the dense DnC, but one chunked gather
-    # for ALL iterations' columns (a direct buf[:, idx] copies the matrix).
+    # for ALL iterations' columns (a direct buf[:, idx] copies the matrix),
+    # fused with the row-norm pass when norms are not precomputed.
     keys = jax.random.split(key, agg.num_iters)
     idxs = jax.vmap(lambda k: jax.random.permutation(k, d)[:sub_dim])(keys)
-    subs = gather_columns(buf, idxs.reshape(-1), c)
-    subs = subs.reshape(n, agg.num_iters, sub_dim).transpose(1, 0, 2)
+    h_sq = pl_.sq_norms() if sq is None else None
+    h_sub = pl_.gather(idxs.reshape(-1))
+    pl_.execute()
+    if h_sq is not None:
+        sq = h_sq.value
+    subs = h_sub.value.reshape(n, agg.num_iters, sub_dim).transpose(1, 0, 2)
 
     def one_iter(sub):
         centered = sub - sub.mean(axis=0)
@@ -457,31 +797,56 @@ def _dnc(agg: DnC, buf, sq, c, key):
         return jnp.argsort(jnp.argsort(s)) < keep
 
     benign = jnp.any(jax.vmap(one_iter)(subs), axis=0)
-    return weighted_row_sum(
-        buf, _masked_mean_w(benign, jnp.ones((n,), jnp.float32)), c
-    )
+    h_out = pl_.weighted_sum(
+        _masked_mean_w(benign, jnp.ones((n,), jnp.float32)))
+    pl_.execute()
+    return h_out.value, sq
 
 
-def _centeredclipping(agg: Centeredclipping, buf, sq, c, state):
-    n, d = buf.shape
+def _centeredclipping(agg: Centeredclipping, pl_: PassPlanner, sq, state):
+    n, d = pl_.n, pl_.d
     momentum = state
     if momentum is None or (isinstance(momentum, tuple) and not momentum):
         momentum = jnp.zeros((d,), jnp.float32)
 
-    def body(_, center):
-        d2 = sq - 2.0 * row_dots(buf, center, c) + center @ center
+    # Initial distances need buf @ momentum once (fused with the norms
+    # when not precomputed); each clip iteration then needs ONE fused
+    # traversal — the clipped weighted sum that moves the center and the
+    # gram_dot that advances buf @ center alongside it:
+    #   center' = center + (w@buf - sum(s)·center)/n
+    #   buf @ center' = dots + (Gs - sum(s)·dots)/n.
+    h_sq = pl_.sq_norms() if sq is None else None
+    h_dots = pl_.dots(momentum)
+    pl_.execute()
+    if h_sq is not None:
+        sq = h_sq.value
+
+    def body(_, carry):
+        center, dots = carry
+        d2 = sq - 2.0 * dots + center @ center
         dist = jnp.sqrt(jnp.maximum(d2, 0.0))
         scale = jnp.minimum(1.0, agg.tau / jnp.maximum(dist, 1e-12))
+        h_ws = pl_.weighted_sum(scale)
+        h_gd = pl_.gram_dot(scale)
+        pl_.execute()
+        s_sum = scale.sum()
         # mean_i clip(x_i - center) = (sum_i s_i x_i - (sum_i s_i) center)/n
-        return center + (
-            weighted_row_sum(buf, scale, c) - scale.sum() * center
-        ) / n
+        new_center = center + (h_ws.value - s_sum * center) / n
+        new_dots = dots + (h_gd.value - s_sum * dots) / n
+        return new_center, new_dots
 
-    momentum = lax.fori_loop(0, agg.n_iter, body, momentum)
-    return momentum, momentum
+    with pl_.loop(agg.n_iter):
+        momentum, _ = lax.fori_loop(
+            0, agg.n_iter, body, (momentum, h_dots.value))
+    return momentum, momentum, sq
 
 
-def _signguard(agg: Signguard, buf, sq, c):
+def _signguard(agg: Signguard, pl_: PassPlanner, sq):
+    h_sq = pl_.sq_norms() if sq is None else None
+    h_sc = pl_.sign_counts()
+    pl_.execute()  # norms + sign features: ONE statistics traversal
+    if h_sq is not None:
+        sq = h_sq.value
     norms = jnp.sqrt(jnp.maximum(sq, 0.0))
     M = jnp.median(norms)
     scale = jnp.minimum(1.0, M / jnp.maximum(norms, 1e-12))
@@ -489,19 +854,28 @@ def _signguard(agg: Signguard, buf, sq, c):
     s1 = (cnorms >= 0.1 * M) & (cnorms <= 3.0 * M)
     # Row-norm scaling never changes a coordinate's sign (scale > 0), so
     # the sign features of the clipped matrix equal those of the raw one.
-    feats = (sign_counts(buf, c) / buf.shape[1]).astype(jnp.float32)
+    feats = (h_sc.value / pl_.d).astype(jnp.float32)
     s2 = clustering.kmeans_majority(feats)
     mask = s1 & s2
     if agg.agg == "mean":
-        return weighted_row_sum(buf, _masked_mean_w(mask, scale), c)
-    return masked_scaled_median(buf, masked._nonempty(mask), scale, c)
+        h_out = pl_.weighted_sum(_masked_mean_w(mask, scale))
+    else:
+        h_out = pl_.masked_median(masked._nonempty(mask), scale)
+    pl_.execute()
+    return h_out.value, sq
 
 
-def _clippedclustering(agg: Clippedclustering, buf, sq, c, state):
-    n = buf.shape[0]
+def _clippedclustering(agg: Clippedclustering, pl_: PassPlanner, sq, state):
+    n = pl_.n
+    h_sq = pl_.sq_norms() if sq is None else None
+    h_g = pl_.gram()
+    h_sc = pl_.sign_counts() if agg.signguard else None
+    pl_.execute()  # norms + Gram (+ sign features): ONE traversal
+    if h_sq is not None:
+        sq = h_sq.value
     norms = jnp.sqrt(jnp.maximum(sq, 0.0))
     if state is None or (isinstance(state, tuple) and not state):
-        state = agg.init(buf.shape[1], n)
+        state = agg.init(pl_.d, n)
     hist, count = state["norm_history"], state["count"]
     cap = hist.shape[0]
     pos = (count + jnp.arange(n)) % cap
@@ -514,74 +888,99 @@ def _clippedclustering(agg: Clippedclustering, buf, sq, c, state):
 
     cnorm = norms * scale
     q = scale / jnp.maximum(cnorm, 1e-12)
-    cos = jnp.clip(q[:, None] * q[None, :] * gram(buf, c), -1.0, 1.0)
+    cos = jnp.clip(q[:, None] * q[None, :] * h_g.value, -1.0, 1.0)
     dist = 1.0 - cos
     zero = cnorm < 1e-12
     bad = zero[:, None] | zero[None, :]
     dist = jnp.where(bad, 2.0, dist)
     mask = clustering.agglomerative_majority(dist, linkage=agg.linkage)
     if agg.signguard:
-        feats = (sign_counts(buf, c) / buf.shape[1]).astype(jnp.float32)
+        feats = (h_sc.value / pl_.d).astype(jnp.float32)
         mask = mask & clustering.kmeans_majority(feats)
     if agg.agg == "mean":
-        out = weighted_row_sum(buf, _masked_mean_w(mask, scale), c)
+        h_out = pl_.weighted_sum(_masked_mean_w(mask, scale))
     else:
-        out = masked_scaled_median(buf, masked._nonempty(mask), scale, c)
-    return out, {"norm_history": hist, "count": count}
+        h_out = pl_.masked_median(masked._nonempty(mask), scale)
+    pl_.execute()
+    return h_out.value, {"norm_history": hist, "count": count}, sq
 
 
-def _fltrust(agg: FLTrust, buf, sq, c, trusted):
+def _fltrust(agg: FLTrust, pl_: PassPlanner, sq, trusted):
     del agg
     if trusted is None:
         raise ValueError(
             "FLTrust requires trusted_update (the server's root-data "
             "update); without it the defense has no root of trust"
         )
+    h_sq = pl_.sq_norms() if sq is None else None
+    h_dots = pl_.dots(trusted)
+    pl_.execute()  # norms + trusted-row dots: ONE statistics traversal
+    if h_sq is not None:
+        sq = h_sq.value
     s_norm = jnp.linalg.norm(trusted)
     c_norm = jnp.maximum(jnp.sqrt(jnp.maximum(sq, 0.0)), 1e-12)
-    cos = row_dots(buf, trusted, c) / (c_norm * jnp.maximum(s_norm, 1e-12))
+    cos = h_dots.value / (c_norm * jnp.maximum(s_norm, 1e-12))
     trust = jax.nn.relu(cos)
     w = trust * (s_norm / c_norm)
-    return weighted_row_sum(buf, w, c) / jnp.maximum(trust.sum(), 1e-12)
+    h_out = pl_.weighted_sum(w)
+    pl_.execute()
+    return h_out.value / jnp.maximum(trust.sum(), 1e-12), sq
 
 
 def aggregate_streamed(
     agg,
     buf: jax.Array,
-    sq: jax.Array,
+    sq: Optional[jax.Array] = None,
     state: Any = (),
     *,
     key: Optional[jax.Array] = None,
     trusted: Optional[jax.Array] = None,
     d_chunk: int = 1 << 17,
-) -> Tuple[jax.Array, Any]:
+    d: Optional[int] = None,
+    recorder: Optional[PassRecorder] = None,
+    fuse: bool = True,
+    use_kernel: Optional[bool] = None,
+    interpret: bool = False,
+) -> Tuple[jax.Array, Any, jax.Array]:
     """Dispatch a row-geometry aggregator over the streamed buffer.
 
     Args:
         agg: an instance of one of ``STREAMED_ROW_AGGREGATORS``.
-        buf: ``(n, d)`` update matrix in storage dtype (post-forge).
-        sq: ``(n,)`` f32 row squared norms of ``buf`` (the caller has
-            them from its materialization pass).
+        buf: ``(n, d_alloc)`` update matrix in storage dtype (post-forge);
+            columns past ``d`` are stripe-alignment padding.
+        sq: ``(n,)`` f32 row squared norms of ``buf``, or ``None`` —
+            the norms request then FUSES into the aggregator's first
+            statistics bundle instead of costing its own traversal.
         state: the aggregator state from ``ServerState.agg_state``.
         key: round aggregation key (DnC's column subsample).
         trusted: the server's root-data update (FLTrust).
+        d: true model width (default ``buf.shape[1]``).
+        recorder/fuse/use_kernel/interpret: see :class:`PassPlanner`.
 
     Returns:
-        ``(aggregate (d,) f32, new_state)``.
+        ``(aggregate (d,) f32, new_state, sq (n,) f32)``.
     """
-    c = d_chunk
+    pl_ = PassPlanner(buf, d_chunk, d=d, recorder=recorder, fuse=fuse,
+                      use_kernel=use_kernel, interpret=interpret)
     if isinstance(agg, GeoMed):
-        return _geomed(agg, buf, sq, c), state
+        out, sq = _geomed(agg, pl_, sq)
+        return out, state, sq
     if isinstance(agg, Multikrum):
-        return _multikrum(agg, buf, sq, c), state
+        out, sq = _multikrum(agg, pl_, sq)
+        return out, state, sq
     if isinstance(agg, DnC):
-        return _dnc(agg, buf, sq, c, key), state
+        out, sq = _dnc(agg, pl_, sq, key)
+        return out, state, sq
     if isinstance(agg, Centeredclipping):
-        return _centeredclipping(agg, buf, sq, c, state)
+        out, new_state, sq = _centeredclipping(agg, pl_, sq, state)
+        return out, new_state, sq
     if isinstance(agg, Signguard):
-        return _signguard(agg, buf, sq, c), state
+        out, sq = _signguard(agg, pl_, sq)
+        return out, state, sq
     if isinstance(agg, Clippedclustering):
-        return _clippedclustering(agg, buf, sq, c, state)
+        out, new_state, sq = _clippedclustering(agg, pl_, sq, state)
+        return out, new_state, sq
     if isinstance(agg, FLTrust):
-        return _fltrust(agg, buf, sq, c, trusted), state
+        out, sq = _fltrust(agg, pl_, sq, trusted)
+        return out, state, sq
     raise NotImplementedError(f"no streamed formulation for {type(agg).__name__}")

@@ -1,0 +1,16 @@
+"""Seeded streamed-pass-discipline violations: raw traversal primitives
+called outside the planner module — each call is a full HBM pass the
+planner can no longer fuse (bare import, aliased import, and attribute
+access through a module alias)."""
+
+from blades_tpu.parallel.streamed_geometry import gram, row_sq_norms
+from blades_tpu.parallel.streamed_geometry import weighted_row_sum as wrs
+from blades_tpu.parallel import streamed_geometry as sg
+
+
+def stats(buf, w):
+    sq = row_sq_norms(buf, 1024)        # BAD: dedicated norms pass
+    g = gram(buf, 1024)                 # BAD: dedicated Gram pass
+    out = wrs(buf, w, 1024)             # BAD: aliased primitive
+    signs = sg.sign_counts(buf, 1024)   # BAD: module-attribute primitive
+    return sq, g, out, signs

@@ -1,0 +1,26 @@
+"""Clean twin of passdiscipline_bad.py: the same statistics submitted as
+planner requests — one fused traversal — plus a same-named helper from a
+DIFFERENT module (ops/layout.py's shard math), which must not
+false-positive."""
+
+from blades_tpu.ops.layout import row_sq_norms as layout_row_sq_norms
+from blades_tpu.parallel.streamed_geometry import PassPlanner, chunk_grid
+
+
+def stats(buf, w):
+    planner = PassPlanner(buf, 1024)
+    h_sq = planner.sq_norms()
+    h_g = planner.gram()
+    h_ws = planner.weighted_sum(w)
+    h_signs = planner.sign_counts()
+    planner.execute()  # ONE traversal serves the whole bundle
+    return h_sq.value, h_g.value, h_ws.value, h_signs.value
+
+
+def shard_norms(rows):
+    # layout.py's row_sq_norms is per-shard math, not a buffer traversal.
+    return layout_row_sq_norms(rows)
+
+
+def grid(d, c):
+    return chunk_grid(d, c)

@@ -37,6 +37,7 @@ from tools.lint.passes.artifacts import (  # noqa: E402
 )
 from tools.lint.passes.donation import DonationPass  # noqa: E402
 from tools.lint.passes.host_sync import HostSyncPass  # noqa: E402
+from tools.lint.passes.pass_discipline import PassDisciplinePass  # noqa: E402
 from tools.lint.passes.prng import PrngPass  # noqa: E402
 from tools.lint.passes.purity import PurityPass  # noqa: E402
 from tools.lint.passes.schema_drift import SchemaDriftPass  # noqa: E402
@@ -137,6 +138,22 @@ def test_schema_drift_fixtures():
                            "schema_stamp_good.py")
     assert errors_of(findings) == []
     assert any("never_stamped" in f.message for f in findings)
+
+
+def test_pass_discipline_fixtures():
+    bad = errors_of(run_fixture([PassDisciplinePass()],
+                                "passdiscipline_bad.py"),
+                    "streamed-pass-discipline")
+    msgs = "\n".join(f.message for f in bad)
+    assert "row_sq_norms()" in msgs
+    assert "gram()" in msgs
+    assert "wrs()" in msgs           # aliased import resolves
+    assert "sg.sign_counts()" in msgs  # module-attribute access
+    assert len(bad) == 4
+    # Clean twin: planner requests + layout.py's SAME-NAMED shard helper
+    # (a different module) produce nothing.
+    assert run_fixture([PassDisciplinePass()],
+                       "passdiscipline_good.py") == []
 
 
 def test_slow_markers_fixture(tmp_path):
@@ -299,7 +316,8 @@ def test_cli_lists_all_passes():
     assert len(names) >= 7  # ISSUE 8: at least 6 passes + the folded audit
     for expected in ("use-after-donate", "prng-reuse", "jit-purity",
                      "host-sync", "static-config", "schema-drift",
-                     "slow-markers", "artifact-stamps"):
+                     "streamed-pass-discipline", "slow-markers",
+                     "artifact-stamps"):
         assert expected in names
 
 
@@ -336,10 +354,11 @@ def test_fixture_dir_is_excluded_from_tree_scan():
 
 @pytest.mark.parametrize("seeded", [
     "donation_bad.py", "prng_bad.py", "purity_bad.py", "hostsync_bad.py",
-    "static_bad.py", "schema_stamp_bad.py"])
+    "static_bad.py", "schema_stamp_bad.py", "passdiscipline_bad.py"])
 def test_every_seeded_violation_class_is_caught(seeded):
-    """ISSUE 8 acceptance: donation reuse, key reuse, env-read-in-jit,
-    host sync, unfrozen static config, unregistered metric key — each
+    """ISSUE 8 acceptance (+ ISSUE 9's pass discipline): donation reuse,
+    key reuse, env-read-in-jit, host sync, unfrozen static config,
+    unregistered metric key, raw-traversal-outside-planner — each
     deliberately-seeded class is caught by its pass."""
     passes = [
         DonationPass(), PrngPass(), PurityPass(),
@@ -347,6 +366,7 @@ def test_every_seeded_violation_class_is_caught(seeded):
         StaticArgsPass(prefixes=[f"{FIX}/static_bad.py"]),
         SchemaDriftPass(schema_module=f"{FIX}/schema_mod.py",
                         stamp_modules=[f"{FIX}/schema_stamp_bad.py"]),
+        PassDisciplinePass(),
     ]
     extra = (["schema_mod.py"] if seeded == "schema_stamp_bad.py" else [])
     findings = run_fixture(passes, seeded, *extra)

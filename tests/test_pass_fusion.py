@@ -1,0 +1,431 @@
+# blades-lint: disable-file=streamed-pass-discipline — property/equivalence tests exercise the raw reference primitives against the planner on purpose
+"""Row-geometry pass fusion (ISSUE 9): the planner's request/plan/execute
+lifecycle, the fused pallas row-stats kernel, and the ``hbm_passes``
+accounting.
+
+Four layers:
+
+1. **Overlap discipline** — randomized ``(d, c)`` property tests of the
+   tail-chunk scheme every fused pass inherits: accumulating passes see
+   each column exactly once (``new_cols`` masks the overlap), idempotent
+   writes see each column at least once.
+2. **Fusion equivalence** — per-aggregator fused-vs-unfused results
+   (bit-comparable on CPU: same chunk values, same updaters) including
+   ALIE/IPM-forged buffers and the empty-benign-mask degradation, plus
+   the planned-traversal regression pins: a refactor that silently
+   de-fuses a bundle fails the exact ``(executed, unfused)`` counts.
+3. **Kernel** — ``ops/pallas_rowstats`` in interpret mode against the
+   chunk path (f32 + bf16, ragged widths, row padding, true-width sign
+   counts), per the ``test_pallas_*`` convention.
+4. **Whole rounds** — streamed rounds with ``fuse_rowgeom`` on/off match
+   and stamp ``hbm_passes``/``hbm_passes_unfused`` (headline case
+   tier-1, per-aggregator zoo slow-marked per the PR 7 budget
+   convention).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from blades_tpu.ops.aggregators import (
+    Centeredclipping,
+    Clippedclustering,
+    DnC,
+    FLTrust,
+    GeoMed,
+    Multikrum,
+    Signguard,
+)
+from blades_tpu.parallel.streamed_geometry import (
+    PassPlanner,
+    PassRecorder,
+    _masked_mean_w,
+    aggregate_streamed,
+    chunk_grid,
+    new_cols,
+    row_sq_norms,
+    weighted_row_sum,
+)
+
+
+def _buf(n=8, d=210, seed=1, outliers=True):
+    rng = np.random.default_rng(seed)
+    B = rng.normal(size=(n, d)).astype(np.float32)
+    if outliers:
+        B[n - 2:] = B[:2].mean(0) * 5 + 1.0
+    return jnp.asarray(B), B
+
+
+# ---------------------------------------------------------------------------
+# 1. tail-chunk overlap discipline (property tests)
+# ---------------------------------------------------------------------------
+
+
+def test_chunk_overlap_exactly_once_property():
+    """Randomized (d, c): the union of ``new_cols`` masks covers every
+    column EXACTLY once (accumulating passes never double-count the
+    overlapped tail), and the chunk ranges cover every column at least
+    once (overwrite passes see everything)."""
+    rng = np.random.default_rng(0)
+    for _ in range(40):
+        d = int(rng.integers(1, 400))
+        c_req = int(rng.integers(1, d + 16))
+        c, k, starts = chunk_grid(d, c_req)
+        starts = np.asarray(starts)
+        counted = np.zeros(d, np.int64)
+        touched = np.zeros(d, bool)
+        for i, s in enumerate(starts):
+            mask = np.asarray(new_cols(int(s), i, c))
+            cols = np.arange(s, s + c)
+            counted[cols[mask]] += 1
+            touched[cols] = True
+        assert (counted == 1).all(), (d, c_req)
+        assert touched.all(), (d, c_req)
+
+
+def test_accumulating_and_overwrite_passes_respect_overlap():
+    """End-to-end on random ragged (d, c): an accumulating request (row
+    norms) and an idempotent-overwrite request (weighted row sum) both
+    come out exact despite the overlapping tail chunk."""
+    rng = np.random.default_rng(3)
+    for _ in range(8):
+        n = int(rng.integers(2, 7))
+        d = int(rng.integers(3, 150))
+        c = int(rng.integers(1, d + 5))
+        B = rng.normal(size=(n, d)).astype(np.float32)
+        w = rng.normal(size=(n,)).astype(np.float32)
+        buf = jnp.asarray(B)
+        p = PassPlanner(buf, c)
+        h_sq, h_ws = p.sq_norms(), p.weighted_sum(jnp.asarray(w))
+        p.execute()
+        np.testing.assert_allclose(np.asarray(h_sq.value), (B**2).sum(1),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(h_ws.value), w @ B,
+                                   rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# 2. fused bundles: equivalence + planned-traversal regression pins
+# ---------------------------------------------------------------------------
+
+
+def test_fused_bundle_matches_reference_primitives():
+    buf, B = _buf(n=9, d=333, seed=0, outliers=False)
+    c = 64
+    v = jnp.asarray(np.linspace(-1, 1, 333), jnp.float32)
+    w = jnp.asarray(np.linspace(0.1, 1, 9), jnp.float32)
+    rec = PassRecorder()
+    p = PassPlanner(buf, c, recorder=rec)
+    h_sq, h_g = p.sq_norms(), p.gram()
+    h_d, h_ws, h_gd = p.dots(v), p.weighted_sum(w), p.gram_dot(w)
+    h_s = p.sign_counts()
+    p.execute()
+    assert (rec.executed, rec.unfused) == (1, 6)
+    np.testing.assert_array_equal(np.asarray(h_sq.value),
+                                  np.asarray(row_sq_norms(buf, c)))
+    np.testing.assert_array_equal(np.asarray(h_ws.value),
+                                  np.asarray(weighted_row_sum(buf, w, c)))
+    np.testing.assert_allclose(np.asarray(h_g.value), B @ B.T,
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(h_d.value), B @ np.asarray(v),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(h_gd.value),
+                               B @ (B.T @ np.asarray(w)),
+                               rtol=1e-3, atol=1e-3)
+    sc = np.asarray(h_s.value)
+    np.testing.assert_array_equal(sc[:, 0], (B > 0).sum(1))
+    np.testing.assert_array_equal(sc[:, 2], (B == 0).sum(1))
+
+
+_AGG_CASES = [
+    # (name, aggregator, state, extra kwargs, executed, unfused) for the
+    # read-only path (sq fused into the first statistics bundle).  The
+    # regression pins: a silently de-fused bundle changes `executed`.
+    ("GeoMed", GeoMed(maxiter=5), (), {}, 6, 13),
+    ("Multikrum", Multikrum(num_byzantine=2, k=3), (), {}, 2, 3),
+    ("DnC", DnC(num_byzantine=2, sub_dim=32, num_iters=2), (),
+     {"key": True}, 2, 3),
+    ("Centeredclipping", Centeredclipping(n_iter=3), (), {}, 4, 8),
+    ("Signguard-mean", Signguard(agg="mean"), (), {}, 2, 3),
+    ("Signguard-median", Signguard(agg="median"), (), {}, 2, 3),
+    ("Clippedclustering", Clippedclustering(signguard=True), (), {}, 2, 4),
+    ("FLTrust", FLTrust(), (), {"trusted": True}, 2, 3),
+]
+
+
+@pytest.mark.parametrize("name,agg,state,extra,n_exec,n_unfused",
+                         _AGG_CASES, ids=[c[0] for c in _AGG_CASES])
+def test_fused_vs_unfused_equivalence_and_planned_passes(
+        name, agg, state, extra, n_exec, n_unfused):
+    """Per aggregator: the fused plan (a) matches the unfused
+    one-traversal-per-request path within the chunk-path tolerances,
+    (b) plans strictly fewer traversals (the ISSUE 9 acceptance:
+    Multikrum/SignGuard statistics 2->1, GeoMed/Centeredclipping
+    per-iteration 2->1), (c) pins the exact planned counts."""
+    buf, B = _buf()
+    kw = {}
+    if extra.get("key"):
+        kw["key"] = jax.random.PRNGKey(3)
+    if extra.get("trusted"):
+        kw["trusted"] = jnp.asarray(
+            np.random.default_rng(5).normal(size=(210,)), jnp.float32)
+    rec_f, rec_u = PassRecorder(), PassRecorder()
+    out_f, st_f, sq_f = aggregate_streamed(
+        agg, buf, None, state, d_chunk=64, recorder=rec_f, **kw)
+    out_u, st_u, sq_u = aggregate_streamed(
+        agg, buf, None, state, d_chunk=64, recorder=rec_u, fuse=False, **kw)
+    np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_u),
+                               rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(np.asarray(sq_f), (B**2).sum(1), rtol=1e-5)
+    assert (rec_f.executed, rec_f.unfused) == (n_exec, n_unfused)
+    # The unfused comparator really runs one traversal per request.
+    assert rec_u.executed == rec_u.unfused == n_unfused
+    # The acceptance criterion: fused plans strictly fewer traversals.
+    assert rec_f.executed < rec_f.unfused
+
+
+def test_precomputed_sq_drops_the_norms_request():
+    """With sq from the materialization pass, the first bundle shrinks
+    by exactly the norms request."""
+    buf, B = _buf()
+    sq = jnp.asarray((B**2).sum(1))
+    rec = PassRecorder()
+    out, _, sq_out = aggregate_streamed(
+        Multikrum(num_byzantine=2, k=3), buf, sq, (), d_chunk=64,
+        recorder=rec)
+    assert (rec.executed, rec.unfused) == (2, 2)
+    assert sq_out is sq
+    rec2 = PassRecorder()
+    out2, _, _ = aggregate_streamed(
+        Multikrum(num_byzantine=2, k=3), buf, None, (), d_chunk=64,
+        recorder=rec2)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out2),
+                               rtol=1e-6, atol=1e-7)
+
+
+@pytest.mark.parametrize("adversary", ["ALIE", "IPM"])
+@pytest.mark.parametrize("name,agg", [
+    ("Multikrum", Multikrum(num_byzantine=2, k=3)),
+    ("GeoMed", GeoMed(maxiter=5)),
+    ("Signguard", Signguard(agg="mean")),
+])
+def test_fused_vs_unfused_on_forged_buffers(adversary, name, agg):
+    """Forged rounds: buffers carrying real ALIE/IPM attack rows (the
+    dense forge applied to the matrix, as the materialization pass
+    leaves it) aggregate identically under the fused and unfused plans."""
+    from blades_tpu.adversaries import get_adversary, make_malicious_mask
+
+    buf, _ = _buf(n=8, d=210, seed=7, outliers=False)
+    mal = make_malicious_mask(8, 2)
+    adv = get_adversary(adversary, num_clients=8, num_byzantine=2)
+    forged = adv.on_updates_ready(buf, mal, jax.random.PRNGKey(11),
+                                  aggregator=agg, global_params=None)
+    out_f, _, _ = aggregate_streamed(agg, forged, None, (), d_chunk=48)
+    out_u, _, _ = aggregate_streamed(agg, forged, None, (), d_chunk=48,
+                                     fuse=False)
+    np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_u),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_empty_benign_mask_degrades_to_all_rows():
+    """The masked-mean finish weights degrade to ALL rows when the
+    defense keeps nobody (masked._nonempty) — identically under both
+    plans."""
+    buf, B = _buf(n=6, d=90, seed=9, outliers=False)
+    scale = jnp.asarray(np.linspace(0.5, 1.0, 6), jnp.float32)
+    empty = jnp.zeros((6,), bool)
+    w = _masked_mean_w(empty, scale)
+    for fuse in (True, False):
+        p = PassPlanner(buf, 32, fuse=fuse)
+        h = p.weighted_sum(w)
+        p.execute()
+        np.testing.assert_allclose(
+            np.asarray(h.value),
+            (np.asarray(scale)[:, None] * B).sum(0) / 6, rtol=1e-4,
+            atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# 3. pallas row-stats kernel (interpret mode, per test_pallas_* convention)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_rowstats_kernel_matches_chunk_path(dtype):
+    from blades_tpu.ops.pallas_rowstats import row_stats_bundle
+
+    rng = np.random.default_rng(4)
+    n, d = 9, 700  # ragged: row pad to 16, column pad to 1024
+    B = rng.normal(size=(n, d)).astype(np.float32)
+    B[2, 17] = 0.0
+    buf = jnp.asarray(B, dtype)
+    v = jnp.asarray(rng.normal(size=(2, d)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(2, n)), jnp.float32)
+    out = row_stats_bundle(buf, sq=True, gram=True, signs=True, dots=v,
+                           weights=w, gram_dot=w, interpret=True)
+    ref = PassPlanner(buf, 256)
+    h_sq, h_g, h_s = ref.sq_norms(), ref.gram(), ref.sign_counts()
+    h_d0, h_d1 = ref.dots(v[0]), ref.dots(v[1])
+    h_w0, h_w1 = ref.weighted_sum(w[0]), ref.weighted_sum(w[1])
+    h_g0, h_g1 = ref.gram_dot(w[0]), ref.gram_dot(w[1])
+    ref.execute()
+    tol = dict(rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(out["sq"], h_sq.value, **tol)
+    np.testing.assert_allclose(out["gram"], h_g.value, **tol)
+    np.testing.assert_array_equal(np.asarray(out["signs"]),
+                                  np.asarray(h_s.value))
+    np.testing.assert_allclose(out["dots"][:, 0], h_d0.value, **tol)
+    np.testing.assert_allclose(out["dots"][:, 1], h_d1.value, **tol)
+    np.testing.assert_allclose(out["wsum"][0], h_w0.value, **tol)
+    np.testing.assert_allclose(out["wsum"][1], h_w1.value, **tol)
+    np.testing.assert_allclose(out["gram_dot"][:, 0], h_g0.value, **tol)
+    np.testing.assert_allclose(out["gram_dot"][:, 1], h_g1.value, **tol)
+
+
+def test_rowstats_kernel_true_width_sign_counts():
+    """A buffer carrying stripe-alignment padding columns (zeros past
+    d_true) must count signs over the TRUE width only — zeros derive
+    from d_true, not the padded width."""
+    from blades_tpu.ops.pallas_rowstats import row_stats_bundle
+
+    rng = np.random.default_rng(6)
+    n, d_true, d_alloc = 8, 300, 512
+    B = np.zeros((n, d_alloc), np.float32)
+    B[:, :d_true] = rng.normal(size=(n, d_true))
+    B[0, 5] = 0.0
+    out = row_stats_bundle(jnp.asarray(B), signs=True, sq=True,
+                           d_true=d_true, interpret=True)
+    sc = np.asarray(out["signs"])
+    np.testing.assert_array_equal(sc[:, 0], (B[:, :d_true] > 0).sum(1))
+    np.testing.assert_array_equal(sc[:, 1], (B[:, :d_true] < 0).sum(1))
+    np.testing.assert_array_equal(sc[:, 2], (B[:, :d_true] == 0).sum(1))
+    np.testing.assert_allclose(out["sq"], (B**2).sum(1), rtol=1e-5)
+
+
+def test_planner_forced_through_kernel_matches_chunk():
+    """The planner's kernel dispatch (forced, interpret mode) agrees
+    with its chunk loop for a full aggregator run."""
+    buf, _ = _buf()
+    agg = Multikrum(num_byzantine=2, k=3)
+    out_k, _, sq_k = aggregate_streamed(agg, buf, None, (), d_chunk=64,
+                                        use_kernel=True, interpret=True)
+    out_c, _, sq_c = aggregate_streamed(agg, buf, None, (), d_chunk=64,
+                                        use_kernel=False)
+    np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_c),
+                               rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(sq_k), np.asarray(sq_c),
+                               rtol=1e-5)
+
+
+def test_rowstats_kernel_gate_rejects_ineligible_shapes():
+    from blades_tpu.ops.pallas_rowstats import kernel_applicable
+
+    # CPU backend (tier-1 runs JAX_PLATFORMS=cpu): never applicable.
+    assert not kernel_applicable(1000, 1 << 23)
+    # Mixed-bundle requests (gather/mean_std/median) are not kernel
+    # kinds: the planner chunk-loops such bundles in ONE traversal.
+    buf, _ = _buf()
+    p = PassPlanner(buf, 64, use_kernel=True, interpret=True)
+    p.sq_norms()
+    p.col_mean_std(jnp.zeros((8,), bool))
+    assert not p._kernel_ok(p._pending)
+
+
+# ---------------------------------------------------------------------------
+# 4. whole streamed rounds: fuse_rowgeom A/B + hbm_passes stamping
+# ---------------------------------------------------------------------------
+
+
+def _round_setup(aggregator, adversary, n=8, f=2):
+    from blades_tpu.adversaries import get_adversary, make_malicious_mask
+    from blades_tpu.core import FedRound, Server, TaskSpec
+
+    task = TaskSpec(model="mlp", input_shape=(8, 8, 1), num_classes=10,
+                    lr=0.1).build()
+    server = Server.from_config(aggregator=aggregator, num_byzantine=f,
+                                lr=0.5)
+    adv = (get_adversary(adversary, num_clients=n, num_byzantine=f)
+           if adversary else None)
+    fr = FedRound(task=task, server=server, adversary=adv, batch_size=4,
+                  num_batches_per_round=1)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(n, 8, 8, 8, 1)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 10, size=(n, 8)), jnp.int32)
+    lengths = jnp.full((n,), 8, jnp.int32)
+    return fr, x, y, lengths, make_malicious_mask(n, f)
+
+
+def _run_round(fr, x, y, ln, mal, fused):
+    from blades_tpu.parallel.streamed import streamed_step
+
+    step = streamed_step(fr, client_block=4, d_chunk=1 << 17,
+                         update_dtype=jnp.float32, donate=False,
+                         fuse_rowgeom=fused)
+    st = fr.init(jax.random.PRNGKey(0), x.shape[0])
+    return step(st, x, y, ln, mal, jax.random.PRNGKey(7))
+
+
+def test_round_stamps_hbm_passes_and_fusion_drops_them():
+    """Headline tier-1 whole-round case: a read-only Multikrum round
+    stamps the planned counts (norms+Gram fused: 2 executed vs 3
+    unfused) and the fused/unfused rounds produce the same result."""
+    fr, x, y, ln, mal = _round_setup("Multikrum", adversary=None)
+    st_f, m_f = _run_round(fr, x, y, ln, mal, fused=True)
+    st_u, m_u = _run_round(fr, x, y, ln, mal, fused=False)
+    assert int(m_f["hbm_passes"]) == 2
+    assert int(m_f["hbm_passes_unfused"]) == 3
+    assert int(m_u["hbm_passes"]) == 3  # the A/B comparator de-fuses
+    assert int(m_f["hbm_passes"]) < int(m_f["hbm_passes_unfused"])
+    for a, b in zip(jax.tree.leaves(st_f.server.params),
+                    jax.tree.leaves(st_u.server.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+    for k in ("train_loss", "agg_norm", "update_norm_mean"):
+        np.testing.assert_allclose(float(m_f[k]), float(m_u[k]), rtol=1e-5)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("aggregator,adversary,expect_hbm", [
+    # Coordinate-wise forge -> materialization traversal (+1) and free
+    # norms; the per-aggregator statistics bundles follow.
+    ("Multikrum", "ALIE", 3),
+    ("Signguard", "ALIE", 3),
+    ("Clippedclustering", "IPM", 3),
+    ("Centeredclipping", "IPM", 1 + 1 + 5),   # mat + dots-init + n_iter
+    ("GeoMed", "ALIE", 1 + 1 + 100),          # mat + init + maxiter bound
+    ("DnC", "IPM", 3),
+    # Row-geometry forge on a read-only buffer: forge bundles + scatter.
+    ("Multikrum", "MinMax", 2 + 1 + 2),       # forge(2) + scatter + agg(2)
+])
+def test_round_hbm_passes_per_aggregator_zoo(aggregator, adversary,
+                                             expect_hbm):
+    """Planned pass-count regression across the zoo: a refactor that
+    silently de-fuses any bundle changes the stamped count."""
+    fr, x, y, ln, mal = _round_setup(aggregator, adversary)
+    _, m = _run_round(fr, x, y, ln, mal, fused=True)
+    assert int(m["hbm_passes"]) == expect_hbm, aggregator
+    assert int(m["hbm_passes"]) <= int(m["hbm_passes_unfused"])
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("aggregator,adversary", [
+    ("GeoMed", "ALIE"),
+    ("Centeredclipping", "IPM"),
+    ("Signguard", "ALIE"),
+    ("Clippedclustering", "ALIE"),
+    ("DnC", "IPM"),
+    ("Multikrum", "MinMax"),
+])
+def test_round_fused_vs_unfused_zoo(aggregator, adversary):
+    """Fused-vs-unfused whole-round equivalence across the zoo
+    (forged rounds included)."""
+    fr, x, y, ln, mal = _round_setup(aggregator, adversary)
+    st_f, m_f = _run_round(fr, x, y, ln, mal, fused=True)
+    st_u, m_u = _run_round(fr, x, y, ln, mal, fused=False)
+    for a, b in zip(jax.tree.leaves(st_f.server.params),
+                    jax.tree.leaves(st_u.server.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(float(m_f["update_norm_mean"]),
+                               float(m_u["update_norm_mean"]), rtol=1e-4)

@@ -10,6 +10,7 @@ and append an instance to :data:`ALL_PASSES`.  Fixture coverage in
 from tools.lint.passes.artifacts import ArtifactStampsPass
 from tools.lint.passes.donation import DonationPass
 from tools.lint.passes.host_sync import HostSyncPass
+from tools.lint.passes.pass_discipline import PassDisciplinePass
 from tools.lint.passes.prng import PrngPass
 from tools.lint.passes.purity import PurityPass
 from tools.lint.passes.schema_drift import SchemaDriftPass
@@ -23,6 +24,7 @@ ALL_PASSES = (
     HostSyncPass(),
     StaticArgsPass(),
     SchemaDriftPass(),
+    PassDisciplinePass(),
     SlowMarkersPass(),
     ArtifactStampsPass(),
 )

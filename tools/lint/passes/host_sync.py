@@ -38,6 +38,7 @@ DEVICE_SIDE = (
     "blades_tpu/ops/layout.py",
     "blades_tpu/ops/masked.py",
     "blades_tpu/ops/pallas_round.py",
+    "blades_tpu/ops/pallas_rowstats.py",
     "blades_tpu/ops/pallas_select.py",
     "blades_tpu/parallel/streamed.py",
     "blades_tpu/parallel/streamed_geometry.py",

@@ -1,0 +1,114 @@
+"""streamed-pass-discipline: raw chunk-traversal primitives stay behind
+the pass planner.
+
+Every raw statistics primitive in
+``blades_tpu/parallel/streamed_geometry.py`` (``row_sq_norms``,
+``gram``, ``row_dots``, ...) is a FULL HBM traversal of the ~10 GB
+streamed update matrix.  The pass planner (``PassPlanner``) exists so
+that statistics live at the same point of an aggregator's dataflow fuse
+into ONE traversal; a direct primitive call from outside the planner
+module silently re-introduces a dedicated pass per statistic — the exact
+regression the ``hbm_passes`` metric was added to catch, enforced here
+statically like donation and host-sync.
+
+Detection is import-based, so same-named helpers in other modules
+(``ops/layout.py`` has its own ``row_sq_norms``/``row_dots`` for the
+d-sharded shard math) never false-positive: a call is flagged only when
+the name was imported from the planner module, or accessed as an
+attribute of it.  Reference/property tests that exercise the raw
+primitives on purpose carry the unified pragma
+(``# blades-lint: disable=streamed-pass-discipline — <why>``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set
+
+from tools.lint import astutil
+from tools.lint.core import Finding, LintContext, LintPass
+
+#: The planner module — the only place raw traversals may be spelled.
+PLANNER_MODULE = "blades_tpu/parallel/streamed_geometry.py"
+_MODULE_DOTTED = "blades_tpu.parallel.streamed_geometry"
+_PARENT_DOTTED = "blades_tpu.parallel"
+
+#: Raw single-statistic traversal primitives (each call = one full HBM
+#: pass).  ``aggregate_streamed`` / ``forge_streamed`` /
+#: ``aggregate_coordwise`` are sanctioned planner-counted entry points
+#: and deliberately absent.
+RAW_PRIMITIVES = frozenset({
+    "row_sq_norms",
+    "gram",
+    "row_dots",
+    "row_dots2",
+    "weighted_row_sum",
+    "sign_counts",
+    "gather_columns",
+    "benign_col_mean_std",
+    "masked_scaled_median",
+    "_pass",
+    "_single",
+})
+
+_HINT = ("submit the statistic as a PassPlanner request "
+         "(streamed_geometry.PassPlanner) so it fuses with the round's "
+         "other traversals, or pragma the line if it is a deliberate "
+         "reference-path use")
+
+
+class PassDisciplinePass(LintPass):
+    name = "streamed-pass-discipline"
+    doc = ("raw streamed_geometry traversal primitives called outside "
+           "the pass planner module")
+
+    def run(self, ctx: LintContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for src in ctx.files:
+            if src.rel == PLANNER_MODULE or src.tree is None:
+                continue
+            fn_aliases, mod_aliases = self._imports(src.tree)
+            if not fn_aliases and not mod_aliases:
+                continue
+            for call in astutil.walk_calls(src.tree):
+                cn = astutil.call_name(call)
+                if cn is None:
+                    continue
+                if cn in fn_aliases:
+                    findings.append(Finding(
+                        self.name, src.rel, call.lineno,
+                        f"direct raw-traversal call {cn}() (one full HBM "
+                        "pass) outside the pass planner module",
+                        fix_hint=_HINT))
+                    continue
+                head, _, tail = cn.rpartition(".")
+                if tail in RAW_PRIMITIVES and head in mod_aliases:
+                    findings.append(Finding(
+                        self.name, src.rel, call.lineno,
+                        f"direct raw-traversal call {cn}() (one full HBM "
+                        "pass) outside the pass planner module",
+                        fix_hint=_HINT))
+        return findings
+
+    @staticmethod
+    def _imports(tree: ast.Module) -> tuple:
+        """(primitive-name aliases, planner-module aliases) bound in this
+        file — including ``import ... as`` renames and the dotted module
+        path itself."""
+        fn_aliases: Dict[str, str] = {}
+        mod_aliases: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom):
+                if node.module == _MODULE_DOTTED:
+                    for alias in node.names:
+                        if alias.name in RAW_PRIMITIVES:
+                            fn_aliases[alias.asname or alias.name] = alias.name
+                elif node.module == _PARENT_DOTTED:
+                    for alias in node.names:
+                        if alias.name == "streamed_geometry":
+                            mod_aliases.add(alias.asname or alias.name)
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name == _MODULE_DOTTED:
+                        mod_aliases.add(alias.asname or alias.name)
+        return fn_aliases, mod_aliases
