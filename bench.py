@@ -17,7 +17,11 @@ Two measured workloads, one JSON line:
    protocol unpacked vs client lane-packed at ``pack_factor=2`` —
    ``parallel/packed.py`` — emitting ``packed_lanes`` and BOTH MFU bases,
    ``mfu_executed``/``mfu_all_lanes``, so the r3->r5 series stays
-   comparable; the same A/B rides the cpu_fallback path.)
+   comparable; the same A/B rides the cpu_fallback path.  And env-gated
+   ``BLADES_BENCH_AUTOTUNE``: the same protocol through the full driver
+   with default knobs vs a measured default-tier execution plan —
+   ``perf/autotune.py`` — reporting the selected plan + provenance,
+   also riding both TPU main and cpu_fallback.)
 2. **ResNet-18 @ 768 clients** (the model BASELINE.json actually names):
    768 is the single-chip capacity limit under malicious-lane elision —
    the benign-compacted bf16 update matrix stores 576 rows = 12.9 GB
@@ -514,6 +518,82 @@ def _rowgeom_block(cpu: bool) -> dict:
     return out
 
 
+def _measure_autotuned(tuned: bool, plan_cache_dir: str, *, num_clients,
+                       model, dataset, input_shape, timed_rounds) -> dict:
+    """One config-driven run of the bench protocol through the FULL
+    driver (``FedavgConfig.build()`` — the layer the autotuner lives
+    in), default knobs vs ``autotune=True`` (the numerics-preserving
+    tier, so both runs compute the identical trajectory).  Tuned runs
+    additionally report the selected plan and its provenance."""
+    from blades_tpu.algorithms import FedavgConfig
+
+    cfg = (
+        FedavgConfig()
+        .data(dataset=dataset, num_clients=num_clients, seed=0)
+        .training(global_model=model, server_lr=0.5, train_batch_size=8,
+                  aggregator={"type": "Median"},
+                  input_shape=input_shape)
+        .client(lr=0.1)
+        .adversary(num_malicious_clients=num_clients // 4,
+                   adversary_config={"type": "ALIE"})
+        .evaluation(evaluation_interval=0)
+    )
+    if tuned:
+        cfg.resources(autotune=True, autotune_cache_dir=plan_cache_dir)
+    algo = cfg.build()
+    algo.train()  # compile + settle
+    t0 = time.perf_counter()
+    for _ in range(timed_rounds):
+        m = algo.train()
+    assert float(m["train_loss"]) == float(m["train_loss"])  # NaN guard
+    dt = time.perf_counter() - t0
+    out = {
+        "round_s": round(dt / timed_rounds, 4),
+        "rounds_per_sec": round(timed_rounds / dt, 4),
+        "clients": num_clients, "model": model,
+        "timed_rounds": timed_rounds, "tuned": tuned,
+    }
+    if tuned and algo.plan is not None:
+        prov = algo.plan_summary or {}
+        out["plan_id"] = algo.plan.plan_id
+        out["plan"] = algo.plan.as_dict()
+        out["selection"] = {
+            "mode": prov.get("mode"),
+            "timed": bool(prov.get("timed")),
+            "cache_hit": bool(prov.get("cache_hit")),
+            "candidates": prov.get("candidates"),
+            "truncated": prov.get("truncated", 0),
+        }
+    return out
+
+
+def _autotune_block(cpu: bool) -> dict:
+    """BLADES_BENCH_AUTOTUNE satellite: tuned-vs-default A/B through the
+    driver (ISSUE 10).  Both arms run the default (numerics-preserving)
+    tier, so the trajectories are bit-identical and the delta is pure
+    execution-plan effect; on TPU the candidates are wall-clock
+    measured, on the cpu_fallback box the deterministic ranked
+    heuristic selects (speedup ~1.0 by construction there — the block
+    then documents the selection record, not a win)."""
+    import tempfile
+
+    if cpu:
+        kw = dict(num_clients=8, model="mlp", dataset="mnist",
+                  input_shape=None, timed_rounds=2)
+    else:
+        kw = dict(num_clients=64, model="cnn", dataset="cifar10",
+                  input_shape=None, timed_rounds=3)
+    with tempfile.TemporaryDirectory(prefix="blades_plan_cache_") as pdir:
+        default = _measure_autotuned(False, pdir, **kw)
+        tuned = _measure_autotuned(True, pdir, **kw)
+    speedup = None
+    if default["rounds_per_sec"]:
+        speedup = round(tuned["rounds_per_sec"]
+                        / default["rounds_per_sec"], 3)
+    return {"default": default, "tuned": tuned,
+            "tuned_speedup": speedup}
+
+
 def _cpu_fallback(probe_err: str) -> None:
     """The relay-dead-box path: measure a REDUCED configuration of the
     same pipeline (FedAvg + ALIE forge + exact Median, dense round, CPU
@@ -563,6 +643,13 @@ def _cpu_fallback(probe_err: str) -> None:
             out["rowgeom"] = _rowgeom_block(cpu=True)
         except Exception as e:
             out["rowgeom"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+    if os.environ.get("BLADES_BENCH_AUTOTUNE", "1") == "1":
+        try:
+            # Execution-autotuner A/B (ISSUE 10) on the reduced CPU
+            # config — tuned (default tier) vs default knobs.
+            out["autotune"] = _autotune_block(cpu=True)
+        except Exception as e:
+            out["autotune"] = {"error": f"{type(e).__name__}: {e}"[:300]}
     _emit(out)
 
 
@@ -641,6 +728,16 @@ def main() -> None:
             out["rowgeom"] = _rowgeom_block(cpu=False)
         except Exception as e:
             out["rowgeom"] = {"error": f"{type(e).__name__}: {e}"[:500]}
+
+    if os.environ.get("BLADES_BENCH_AUTOTUNE", "1") == "1":
+        try:
+            # Execution-autotuner A/B (ISSUE 10): the same protocol
+            # through the full driver with default knobs vs a measured
+            # default-tier plan (bit-identical trajectories — the delta
+            # is pure execution-plan effect).
+            out["autotune"] = _autotune_block(cpu=False)
+        except Exception as e:
+            out["autotune"] = {"error": f"{type(e).__name__}: {e}"[:500]}
 
     _emit(out)
 

@@ -108,6 +108,31 @@ class FedavgConfig:
         self.client_block: int = 50        # clients per streamed dispatch
         self.d_chunk: int = 1 << 17        # coords per streamed agg chunk
         self.update_dtype: str = "bfloat16"  # streamed matrix storage
+        # MXU finish variant for the streamed pallas finish
+        # (ops/pallas_round.py): None = defer to the
+        # BLADES_TPU_MXU_FINISH env default, "" = VPU reductions,
+        # "counts" = radix counts on the MXU (bit-exact), "all" = also
+        # the forged-row stats (f32 reassociation ulps).  The env var
+        # remains an explicit per-process override over this field.
+        self.mxu_finish: Optional[str] = None
+        # Execution autotuner (perf/autotune.py): False/"off" disables;
+        # True/"on" tunes over the numerics-preserving default tier
+        # (bit-identical to the untuned path); "reassociating" also
+        # offers dense<->streamed<->packed switches and the stats-MXU
+        # finish (documented float-reassociation tolerances).  Winners
+        # persist to the on-disk plan cache (autotune_cache_dir /
+        # $BLADES_TPU_PLAN_CACHE_DIR).  Explicitly-set knobs (execution,
+        # d_chunk, client_packing, mxu_finish, rounds_per_dispatch,
+        # prefetch) are never varied — the tuner only resolves what was
+        # left at "auto"/default.
+        self.autotune: Any = False
+        self.autotune_cache_dir: Optional[str] = None
+        # Explicit plan pin: a Plan dict (perf.autotune.Plan.as_dict)
+        # applied verbatim instead of tuning — how a resumed sweep
+        # replays the EXACT plan its checkpoints were written under
+        # (no silent re-tune drift mid-trajectory), and how operators
+        # pin a plan from tools/show_plan.py output.
+        self.tuned_plan: Optional[Dict] = None
         # client lane-packing (parallel/packed.py): fold P clients into
         # one grouped-kernel vmap lane on the dense path.  "off" | "auto"
         # (pack_factor 2 iff the width/divisibility/hook heuristic passes,
@@ -153,6 +178,15 @@ class FedavgConfig:
         # copy()-then-rebuild re-infers instead of keeping stale values
         # (VERDICT r1: the reference freezes after validate for this).
         self._inferred: set = set()
+        # Names of fields the USER set (fluent setters / dict merge),
+        # as opposed to class defaults.  The execution autotuner's
+        # composition contract keys off this: an explicitly-set knob is
+        # pinned in the plan space, a defaulted one may be tuned.
+        self._explicit: set = set()
+        # Scan-window candidates the sweep runner computed for the
+        # autotuner (eligible chained windows, descending); private
+        # plumbing like _packing_decision.
+        self._autotune_windows = None
 
     # -- fluent setters ------------------------------------------------------
 
@@ -171,6 +205,7 @@ class FedavgConfig:
                 self._inferred.discard("num_classes")
         setattr(self, k, v)
         self._inferred.discard(k)
+        self._explicit.add(k)
 
     def _set(self, **kw):
         if self._frozen:
@@ -216,12 +251,16 @@ class FedavgConfig:
 
     def resources(self, *, num_devices=None, execution=None, client_block=None,
                   d_chunk=None, update_dtype=None, compute_dtype=None,
-                  client_packing=None):
+                  client_packing=None, mxu_finish=None, autotune=None,
+                  autotune_cache_dir=None, tuned_plan=None):
         return self._set(num_devices=num_devices, execution=execution,
                          client_block=client_block, d_chunk=d_chunk,
                          update_dtype=update_dtype,
                          compute_dtype=compute_dtype,
-                         client_packing=client_packing)
+                         client_packing=client_packing,
+                         mxu_finish=mxu_finish, autotune=autotune,
+                         autotune_cache_dir=autotune_cache_dir,
+                         tuned_plan=tuned_plan)
 
     def fault_tolerance(self, *, health_check=None, faults=None):
         """In-round failure detection / elastic recovery (core/health.py)
@@ -442,6 +481,26 @@ class FedavgConfig:
                 f"update_dtype must be 'bfloat16' or 'float32', got "
                 f"{self.update_dtype!r}"
             )
+        if self.mxu_finish not in (None, "", "counts", "all"):
+            raise ValueError(
+                "mxu_finish must be None (env default), '', 'counts' or "
+                f"'all', got {self.mxu_finish!r}"
+            )
+        self.autotune_mode  # fail-fast on a bad autotune value
+        if self.autotune_mode:
+            if self.num_devices and self.num_devices > 1:
+                raise ValueError(
+                    "the execution autotuner is single-chip for now: its "
+                    "plan space covers the dense/streamed single-chip "
+                    "paths — run the tuned pass without num_devices, or "
+                    "disable autotune"
+                )
+        if self.tuned_plan is not None:
+            # Parse the pin now so a bad plan dict fails at validate()
+            # time (same fail-fast discipline as faults/codecs).
+            from blades_tpu.perf.autotune import Plan
+
+            Plan.from_dict(self.tuned_plan)
         if self.chained_dispatch and self.num_devices and self.num_devices > 1:
             raise ValueError(
                 "chained_dispatch (the sweep's scan-window key discipline) "
@@ -462,6 +521,22 @@ class FedavgConfig:
                 f"evaluation_num_samples must be >= 1 (or None for the full "
                 f"per-client shard), got {self.evaluation_num_samples}"
             )
+
+    @property
+    def autotune_mode(self) -> Optional[str]:
+        """Normalized autotune request: ``None`` (off), ``"default"``
+        (numerics-preserving tier only) or ``"reassociating"`` (opt-in
+        tier included)."""
+        v = self.autotune
+        if v in (False, None, 0, "off", ""):
+            return None
+        if v in (True, 1, "on", "default"):
+            return "default"
+        if v == "reassociating":
+            return "reassociating"
+        raise ValueError(
+            f"autotune must be off|on|reassociating (or bool), got {v!r}"
+        )
 
     def freeze(self) -> None:
         self._frozen = True

@@ -92,6 +92,17 @@ class Fedavg:
             tln = jnp.minimum(tln, cap)
         self._test_arrays = (tx, ty, tln)
 
+        # Execution autotuner (perf/autotune.py): resolve the measured
+        # plan — or the checkpoint/operator pin, or the cached winner —
+        # and materialise it into the config knobs BEFORE the pipeline
+        # below reads them.  None when autotune is off: every path then
+        # behaves exactly as before.
+        self._plan = None
+        self._plan_provenance = None
+        if getattr(cfg, "autotune_mode", None):
+            self._plan, self._plan_provenance = self._resolve_autotune_plan()
+            self._apply_plan(self._plan)
+
         self._chunk = max(1, int(getattr(cfg, "rounds_per_dispatch", 1)))
         # Chained key discipline (multi_step_chained): each scanned round
         # consumes split(carry) exactly like the sequential driver, so
@@ -201,6 +212,7 @@ class Fedavg:
             streamed_kw = dict(
                 client_block=self._streamed_block(),
                 d_chunk=cfg.d_chunk,
+                mxu_finish=getattr(cfg, "mxu_finish", None),
                 update_dtype=getattr(jnp, str(cfg.update_dtype)),
                 # self.malicious IS the canonical prefix mask (built via
                 # make_malicious_mask above) — lets forged-update rounds
@@ -337,6 +349,15 @@ class Fedavg:
         for k, v in self.config.items():
             if k == "seed":
                 continue
+            if k in ("autotune", "autotune_cache_dir", "tuned_plan"):
+                # The autotune REQUEST steers nothing in the traced
+                # program — the knobs a resolved plan materialises
+                # (execution, d_chunk, ...) are ordinary config fields
+                # already in this fingerprint.  Excluding the request
+                # lets the tuner's measurement candidates share their
+                # compiled executables with the winning plan's real run,
+                # and gives the plan cache a pre-resolution key.
+                continue
             if k == "dataset" and not isinstance(v, (str, dict)):
                 v = f"<dataset:{getattr(v, 'name', type(v).__name__)}>"
             if not plain(v):
@@ -417,18 +438,11 @@ class Fedavg:
         > 1 since round 5)."""
         return self._dense_matrix_bytes() > self.dense_matrix_hbm_limit()
 
-    def _use_streamed(self) -> bool:
-        """Pick the single-chip streaming round (parallel/streamed.py).
-
-        Explicit ``execution='streamed'`` always; ``'auto'`` when the
-        dense f32 ``(n, d)`` update matrix would strain a 16 GB chip's
-        HBM (> ~6 GB) — the giant-federation regime the streamed path
-        exists for."""
-        cfg = self.config
-        if cfg.execution == "dense":
-            return False
-        if cfg.execution == "streamed":
-            return True
+    def _streamed_supported(self) -> bool:
+        """The static half of the streamed-execution gate: does this
+        round's aggregator/forger pair have a streamed formulation at
+        all?  (Feasibility only — the HBM trigger that makes ``'auto'``
+        actually pick it lives in :meth:`_use_streamed`.)"""
         from blades_tpu.parallel.streamed import (
             _COORDWISE_AGGREGATORS,
             _COORDWISE_FORGERS,
@@ -449,7 +463,261 @@ class Fedavg:
             fr.adversary, _COORDWISE_FORGERS + streamed_row_forgers()
         ):
             return False
+        return True
+
+    def _use_streamed(self) -> bool:
+        """Pick the single-chip streaming round (parallel/streamed.py).
+
+        Explicit ``execution='streamed'`` always; ``'auto'`` when the
+        dense f32 ``(n, d)`` update matrix would strain a 16 GB chip's
+        HBM (> ~6 GB) — the giant-federation regime the streamed path
+        exists for."""
+        cfg = self.config
+        if cfg.execution == "dense":
+            return False
+        if cfg.execution == "streamed":
+            return True
+        if not self._streamed_supported():
+            return False
         return self._dense_matrix_bytes() > self.dense_matrix_hbm_limit()
+
+    # -- execution autotuner (perf/autotune.py) ------------------------------
+
+    def _d_chunk_exact(self) -> bool:
+        """Whether the streamed finish's output is invariant to the
+        ``d_chunk`` partition, bit for bit — the gate that keeps the
+        chunk ladder in the autotuner's numerics-preserving tier.
+
+        Chunk-size changes are exact for coordinate-wise aggregators on
+        deterministic coordinate-wise forges (every statistic is
+        per-column).  They are NOT for: DP (noise keys fold the chunk
+        index), Noise/Adaptive forges (per-chunk key folds / draws),
+        health checks (chunk-local sanitize keeps different slices of a
+        partially-non-finite lane), and the row-geometry aggregators
+        (row statistics accumulate in chunk order).  Those rounds keep
+        the configured chunk."""
+        from blades_tpu.adversaries.update_attacks import (AdaptiveAdversary,
+                                                           NoiseAdversary)
+        from blades_tpu.parallel.streamed import (_COORDWISE_AGGREGATORS,
+                                                  _COORDWISE_FORGERS,
+                                                  _adv_forges)
+
+        fr = self.fed_round
+        if fr.dp_clip_threshold is not None or fr.health_check:
+            return False
+        if not isinstance(fr.server.aggregator, _COORDWISE_AGGREGATORS):
+            return False
+        adv = fr.adversary
+        if _adv_forges(adv):
+            if isinstance(adv, (AdaptiveAdversary, NoiseAdversary)):
+                return False
+            if not isinstance(adv, _COORDWISE_FORGERS):
+                return False
+        return True
+
+    def _plan_space(self, allow_reassociating: bool):
+        """Enumerate this trial's legal execution plans (see
+        :func:`blades_tpu.perf.autotune.enumerate_plans`).  Every
+        per-knob candidate list is ordered current-resolution-first and
+        collapses to one entry when the user set the knob explicitly —
+        the composition contract ``--autotune`` documents."""
+        import os
+
+        from blades_tpu.perf import autotune as at
+
+        cfg = self.config
+        explicit = getattr(cfg, "_explicit", set()) or set()
+        baseline_streamed = self._use_streamed()
+        dense_features = (cfg.forensics or cfg.fault_config
+                          or cfg.codec_config)
+        packing = getattr(self.fed_round, "packing", None)
+        base_pack = int(packing.pack) if packing is not None else 1
+
+        # Execution paths: forced values pin the list; under "auto" the
+        # alternate path is reassociating-tier and only legal when its
+        # own constraints hold (dense must fit HBM; streamed needs a
+        # formulation and none of the dense-only features).
+        if cfg.execution in ("dense", "streamed"):
+            execs = [cfg.execution]
+        else:
+            execs = ["streamed" if baseline_streamed else "dense"]
+            if allow_reassociating:
+                if (baseline_streamed and not dense_features
+                        and self._dense_matrix_bytes()
+                        <= self.dense_matrix_hbm_limit()):
+                    execs.append("dense")
+                elif (not baseline_streamed and self._streamed_supported()
+                      and not dense_features and base_pack == 1
+                      and not (cfg.num_devices and cfg.num_devices > 1)):
+                    execs.append("streamed")
+        streamed_in_space = "streamed" in execs
+
+        # Streamed chunk ladder (default tier; exact only when the
+        # finish is chunk-invariant, see _d_chunk_exact).
+        d_chunks = [int(cfg.d_chunk)]
+        if (streamed_in_space and "d_chunk" not in explicit
+                and self._d_chunk_exact()):
+            d_model = self._num_params if hasattr(self, "_num_params") else \
+                sum(p.size for p in jax.tree.leaves(self.state.server.params))
+            seen = {min(int(cfg.d_chunk), d_model)}
+            for c in at.D_CHUNK_LADDER:
+                eff = min(int(c), d_model)
+                if eff not in seen:
+                    seen.add(eff)
+                    d_chunks.append(int(c))
+
+        # MXU finish: the env var is an explicit per-process override,
+        # an explicit config value pins it; otherwise the tuner varies
+        # it ("counts" is bit-exact — default tier; "all" reassociates
+        # the forged-row stats — opt-in tier).
+        env_mxu = os.environ.get("BLADES_TPU_MXU_FINISH")
+        if env_mxu is not None:
+            mxu_modes = [env_mxu]
+        elif cfg.mxu_finish is not None:
+            mxu_modes = [cfg.mxu_finish]
+        else:
+            mxu_modes = ["", "counts", "all"]
+
+        # Pack factors (dense only; packing reassociates the per-client
+        # convolutions).  The resolved baseline comes first; alternates
+        # are probed through resolve_client_packing itself so only
+        # structurally-possible factors enter the space.  Composition
+        # contract: a forced int pins trivially, and an EXPLICIT "off"
+        # pins too — only "auto" (a standing request to resolve) or the
+        # untouched default may be varied.
+        packs = [base_pack]
+        if (allow_reassociating and "dense" in execs
+                and not isinstance(cfg.client_packing, int)
+                and (cfg.client_packing == "auto"
+                     or "client_packing" not in explicit)):
+            from blades_tpu.parallel.packed import resolve_client_packing
+
+            for p in (1, 2, 4):
+                if p in packs or cfg.num_clients % p:
+                    continue
+                if p == 1:
+                    packs.append(1)
+                    continue
+                try:
+                    stripped = _dc_replace(self.fed_round, packing=None)
+                    _, dec = resolve_client_packing(
+                        stripped, p, num_clients=cfg.num_clients,
+                        num_devices=cfg.num_devices, execution="dense")
+                except Exception:
+                    continue
+                if dec and int(dec.get("pack_factor", 1)) == p:
+                    packs.append(p)
+
+        # Scan windows: a pinned rounds_per_dispatch stays pinned; the
+        # sweep runner supplies the eligible chained windows
+        # (descending, its own current pick first) via
+        # _autotune_windows — outside a sweep there is no window
+        # machinery to drive, so the space stays at 1.
+        rpd = int(getattr(cfg, "rounds_per_dispatch", 1) or 1)
+        if rpd != 1:
+            windows = [rpd]
+        else:
+            windows = [int(w) for w in
+                       (getattr(cfg, "_autotune_windows", None) or (1,))]
+
+        # Prefetch (dense single-round batch staging, bit-transparent):
+        # resolved default first, the flip offered only when left "auto".
+        base_pre = (False if cfg.prefetch in (False, "off")
+                    else True if cfg.prefetch in (True, "on")
+                    else jax.default_backend() != "cpu")
+        prefetch_options = [base_pre]
+        if cfg.prefetch == "auto" and "prefetch" not in explicit:
+            prefetch_options.append(not base_pre)
+
+        return at.enumerate_plans(
+            executions=execs, d_chunks=d_chunks, mxu_modes=mxu_modes,
+            pack_factors=packs, scan_windows=windows,
+            prefetch_options=prefetch_options,
+            allow_reassociating=allow_reassociating,
+        )
+
+    def _resolve_autotune_plan(self):
+        """Resolve this trial's execution plan: the explicit
+        ``tuned_plan`` pin, the on-disk plan-cache winner, a measured
+        selection (TPU), or the deterministic ranked heuristic (CPU /
+        timing unavailable) — in that order.  Returns
+        ``(Plan, provenance dict)``; the provenance flows into sweep
+        summaries and the schema-registered round fields."""
+        from blades_tpu.perf import autotune as at
+
+        cfg = self.config
+        mode = cfg.autotune_mode
+        pinned = getattr(cfg, "tuned_plan", None)
+        if pinned:
+            plan = at.Plan.from_dict(pinned)
+            return plan, {
+                "mode": "pinned", "timed": False, "cache_hit": False,
+                "winner": plan.as_dict(), "winner_id": plan.plan_id,
+                "candidates": [], "truncated": 0,
+            }
+        space = self._plan_space(
+            allow_reassociating=(mode == "reassociating"))
+        cache = at.PlanCache(getattr(cfg, "autotune_cache_dir", None))
+        fp = self._program_fingerprint()
+        key = at.cache_key(fp, tier=mode) if fp else None
+        cache_stale = False
+        if key is not None:
+            entry = cache.get(key)
+            if entry is not None:
+                plan = at.Plan.from_dict(entry["plan"])
+                if plan in space.candidates:
+                    prov = dict(entry.get("provenance") or {})
+                    prov.update({"mode": "cache", "cache_hit": True,
+                                 "winner": plan.as_dict(),
+                                 "winner_id": plan.plan_id})
+                    return plan, prov
+                # The cached winner is no longer in THIS run's legal
+                # space: the fingerprint can't see sweep-level window
+                # context (max_rounds / checkpoint_freq shape the
+                # eligible scan windows), so a winner tuned under one
+                # round budget could carry a rounds_per_dispatch that
+                # overshoots another run's stop criterion or skips its
+                # checkpoint boundaries.  Re-tune (and overwrite below)
+                # rather than apply a plan the current constraints
+                # forbid.
+                cache_stale = True
+        measure = (at.timed_measure_fn(cfg) if at.timing_available()
+                   else None)
+        plan, prov = at.select_plan(space, measure_fn=measure)
+        if cache_stale:
+            prov["cache_stale"] = True  # surfaced in sweep summaries
+        if key is not None:
+            cache.put(key, plan, prov)
+        return plan, prov
+
+    def _apply_plan(self, plan) -> None:
+        """Materialise the resolved plan into the config knobs the
+        pipeline setup below reads, and re-resolve lane packing when the
+        plan's pack factor differs from what ``get_fed_round`` built."""
+        from blades_tpu.perf.autotune import apply_plan
+
+        cfg = self.config
+        apply_plan(cfg, plan)
+        packing = getattr(self.fed_round, "packing", None)
+        cur = int(packing.pack) if packing is not None else 1
+        want = int(plan.client_packing or 1)
+        if want == cur:
+            return
+        fr = _dc_replace(self.fed_round, packing=None)
+        if want >= 2:
+            from blades_tpu.parallel.packed import resolve_client_packing
+
+            fr, decision = resolve_client_packing(
+                fr, want, num_clients=cfg.num_clients,
+                num_devices=cfg.num_devices, execution=plan.execution)
+            cfg._packing_decision = decision
+        else:
+            cfg._packing_decision = {
+                "requested": cfg.client_packing, "pack_factor": 1,
+                "packed_lanes": cfg.num_clients,
+                "fallback": "autotune plan selected unpacked execution",
+            }
+        self.fed_round = fr
 
     def _streamed_block(self) -> int:
         """Largest divisor of num_clients that is <= the configured
@@ -498,6 +766,19 @@ class Fedavg:
     @property
     def iteration(self) -> int:
         return self._iteration
+
+    @property
+    def plan(self):
+        """The resolved execution :class:`~blades_tpu.perf.autotune.Plan`
+        this instance runs under, or ``None`` when autotune is off."""
+        return self._plan
+
+    @property
+    def plan_summary(self) -> Optional[Dict]:
+        """Autotune provenance for sweep summaries: selection mode
+        (measured / heuristic / cache / pinned), per-candidate timings,
+        winner and cache hit/miss.  ``None`` when autotune is off."""
+        return self._plan_provenance
 
     @property
     def packing_summary(self) -> Optional[Dict]:
@@ -628,6 +909,17 @@ class Fedavg:
             row["pack_factor"] = int(packing.pack)
             row["packed_lanes"] = int(self.config.num_clients
                                       // packing.pack)
+        if self._plan is not None:
+            # Execution-autotuner provenance (perf/autotune.py): static
+            # per trial, stamped host-side so every row names the plan
+            # it ran under and how that plan was selected.  The full
+            # candidate/timing breakdown rides the sweep summary
+            # (plan_summary); rows carry the scalar slice.
+            prov = self._plan_provenance or {}
+            row["plan_id"] = self._plan.plan_id
+            row["autotune_cache_hit"] = bool(prov.get("cache_hit"))
+            row["autotune_timed"] = bool(prov.get("timed"))
+            row["autotune_candidates"] = len(prov.get("candidates") or [])
         if "hbm_passes" in metrics:
             # Row-geometry pass-fusion accounting (streamed path): planned
             # full-matrix traversals per finish, fused plan vs the
@@ -772,6 +1064,14 @@ class Fedavg:
             "pack_factor": (int(self.fed_round.packing.pack)
                             if getattr(self.fed_round, "packing", None)
                             is not None else 1),
+            # Resolved execution plan (perf/autotune.py), recorded so a
+            # kill-and-resume replays the IDENTICAL plan instead of
+            # silently re-tuning mid-trajectory: the sweep runner pins
+            # it back via config.tuned_plan before rebuilding (see
+            # tune/sweep.py _pin_checkpoint_plan); load_checkpoint
+            # warns on a mismatch for direct-API resumes.
+            "plan": (self._plan.as_dict() if self._plan is not None
+                     else None),
             "config_dict": {k: v for k, v in self.config.items()
                             if not callable(v)},
         }
@@ -788,6 +1088,23 @@ class Fedavg:
             payload = pickle.load(f)
         self._iteration = payload["iteration"]
         self._rounds_since_eval = payload.get("rounds_since_eval", 0)
+        saved_plan = payload.get("plan")
+        cur_plan = self._plan.as_dict() if self._plan is not None else None
+        if saved_plan is not None and saved_plan != cur_plan:
+            # Plan drift on resume: this instance resolved a different
+            # execution plan than the one the checkpoint was written
+            # under (a re-tune picked a new winner, or the plan cache
+            # moved).  Default-tier plans are bit-identical so the
+            # trajectory is safe either way, but reassociating-tier
+            # drift silently changes numerics mid-run — surface it and
+            # point at the pin.  The sweep runner never hits this: it
+            # pins config.tuned_plan from the checkpoint before build.
+            warnings.warn(
+                f"checkpoint was written under execution plan "
+                f"{saved_plan} but this instance resolved {cur_plan}; "
+                "pin the saved plan via "
+                "FedavgConfig.resources(tuned_plan=...) to replay it "
+                "identically", RuntimeWarning, stacklevel=2)
         self._key = jnp.asarray(payload["key"])
         state = jax.tree.map(jnp.asarray, payload["state"])
         # Realign per-client state when the saved client layout differs

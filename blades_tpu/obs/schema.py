@@ -95,6 +95,17 @@ ROUND_RECORD_FIELDS: Dict[str, Tuple[tuple, bool]] = {
     # the cache reports misses == 0 from its first row.
     "compile_cache_hits": ((int,), False),
     "compile_cache_misses": ((int,), False),
+    # Execution autotuner (perf/autotune.py): the plan this round ran
+    # under (plan_id, compact knob encoding) and how it was selected —
+    # served from the persistent plan cache (autotune_cache_hit),
+    # measured vs the deterministic heuristic fallback (autotune_timed),
+    # over how many enumerated candidates.  Static per trial; the full
+    # per-candidate timing breakdown rides the sweep summary's
+    # "autotune" block.  Absent on untuned runs.
+    "plan_id": ((str,), False),
+    "autotune_cache_hit": ((bool,), False),
+    "autotune_timed": ((bool,), False),
+    "autotune_candidates": ((int,), False),
     # defense forensics (obs/forensics.py)
     "byz_precision": (_NUM, False),
     "byz_recall": (_NUM, False),

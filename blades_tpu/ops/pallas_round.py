@@ -424,14 +424,30 @@ def _compact_kernel(x_ref, wb_ref, r_ref, o_ref, sq_ref, bad_ref, fr_ref, *,
         raise ValueError(f"unknown aggregator {akind!r}")
 
 
-def _mxu_mode_from_env() -> Tuple[bool, bool]:
-    """``(radix_mxu, stats_mxu)`` from ``BLADES_TPU_MXU_FINISH``
-    ("", "counts", or "all"), read at CALL time by the un-jitted
-    :func:`fused_finish_compact` wrapper."""
+def parse_mxu_mode(mode: str) -> Tuple[bool, bool]:
+    """``(radix_mxu, stats_mxu)`` from a finish-mode string: ``""``
+    (VPU reductions), ``"counts"`` (radix counts on the MXU — bit-exact,
+    small integers are exact in f32) or ``"all"`` (also the forged-row
+    mean/var and row-norm reductions — same values up to f32
+    reassociation ulps)."""
+    return mode in ("counts", "all"), mode == "all"
+
+
+def _mxu_mode_resolve(mxu_finish: Optional[str]) -> Tuple[bool, bool]:
+    """``(radix_mxu, stats_mxu)`` for the un-jitted
+    :func:`fused_finish_compact` wrapper, resolved at CALL time.
+
+    Precedence: the ``BLADES_TPU_MXU_FINISH`` env var when SET (the
+    explicit per-process override, kept from the PR 4 fix) beats the
+    caller's config-resolved ``mxu_finish`` (the first-class
+    ``resources(mxu_finish=...)`` field the autotuner selects per
+    plan), which beats the ``""`` default."""
     import os
 
-    mode = os.environ.get("BLADES_TPU_MXU_FINISH", "")  # blades-lint: disable=jit-purity — read per call by the un-jitted dispatch wrapper, never traced (the r5 fix)
-    return mode in ("counts", "all"), mode == "all"
+    env = os.environ.get("BLADES_TPU_MXU_FINISH")  # blades-lint: disable=jit-purity — read per call by the un-jitted dispatch wrapper, never traced (the r5 fix)
+    if env is not None:
+        return parse_mxu_mode(env)
+    return parse_mxu_mode(mxu_finish or "")
 
 
 def fused_finish_compact(
@@ -446,11 +462,15 @@ def fused_finish_compact(
     interpret: bool = False,
     radix_mxu: Optional[bool] = None,
     stats_mxu: Optional[bool] = None,
+    mxu_finish: Optional[str] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Forge + aggregate over a BENIGN-ONLY update matrix in one pass.
 
     Thin un-jitted wrapper: ``radix_mxu``/``stats_mxu`` default to the
-    ``BLADES_TPU_MXU_FINISH`` env var ("", "counts", or "all"),
+    resolved finish mode — the ``BLADES_TPU_MXU_FINISH`` env var when
+    set (explicit per-process override), else the caller's
+    config-resolved ``mxu_finish`` string (``resources(mxu_finish=...)``,
+    selectable per plan by the execution autotuner), else ``""`` —
     resolved HERE — outside the jit — on every call, then passed to the
     jitted body as concrete static booleans.  Resolving inside the
     traced body (the previous design) cached the first call's mode
@@ -462,7 +482,7 @@ def fused_finish_compact(
     contract.
     """
     if radix_mxu is None or stats_mxu is None:
-        env_radix, env_stats = _mxu_mode_from_env()
+        env_radix, env_stats = _mxu_mode_resolve(mxu_finish)
         if radix_mxu is None:
             radix_mxu = env_radix
         if stats_mxu is None:

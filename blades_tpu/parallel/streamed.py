@@ -91,6 +91,14 @@ _COORDWISE_FORGERS = (ALIEAdversary, IPMAdversary, NoiseAdversary,
                       AdaptiveAdversary)
 _COORDWISE_AGGREGATORS = (Mean, Median, Trimmedmean)
 
+# Canonical streamed-finish chunk width (the historical hard-coded
+# value, now named).  The config default (algorithms/config.py), the
+# bench protocol (bench.py D_CHUNK) and the center of the autotuner's
+# candidate ladder (perf/autotune.py D_CHUNK_LADDER — stdlib-only by
+# design, so it repeats the literal) all pin the same 1 << 17; the
+# autotuner's chunk tests assert the agreement.
+DEFAULT_D_CHUNK = 1 << 17
+
 
 def _fused_spec(fr: FedRound):
     """(forge, agg) tuples for the one-pass pallas finish
@@ -130,11 +138,12 @@ def streamed_step(
     fr: FedRound,
     *,
     client_block: int = 50,
-    d_chunk: int = 1 << 17,
+    d_chunk: int = DEFAULT_D_CHUNK,
     update_dtype=jnp.bfloat16,
     donate: bool = True,
     malicious_prefix: int | None = None,
     fuse_rowgeom: bool = True,
+    mxu_finish: str | None = None,
 ) -> Callable:
     """Build the streaming round (a host-side callable over jitted parts).
 
@@ -192,6 +201,13 @@ def streamed_step(
             against.  Row-geometry rounds stamp ``hbm_passes`` /
             ``hbm_passes_unfused`` (planned full-matrix traversals,
             fused plan vs per-request baseline) into the round metrics.
+        mxu_finish: config-resolved MXU finish variant for the compact
+            fused pallas finish (``""``/``"counts"``/``"all"``; see
+            :func:`blades_tpu.ops.pallas_round.parse_mxu_mode`).
+            ``None`` defers to the per-call env default; the
+            ``BLADES_TPU_MXU_FINISH`` env var, when SET, overrides this
+            value either way.  Pinned at this build's trace time like
+            every other static knob here.
     """
     from blades_tpu.parallel.streamed_geometry import (
         STREAMED_ROW_AGGREGATORS,
@@ -429,6 +445,7 @@ def streamed_step(
         agg_vec, sq_b, bad_b, forged = fused_finish_compact(
             updates_buf, noise, forged_mult=malicious_prefix, forge=forge,
             agg=aspec, sanitize=fr.health_check, num_real=nb_real,
+            mxu_finish=mxu_finish,
         )
         agg_vec, forged = agg_vec[:d], forged[:d]
         fsq = forged @ forged

@@ -16,9 +16,23 @@ robust-FL throughput ceiling here, not in the defense kernels):
   replay guarantees hold).
 - :mod:`blades_tpu.data.prefetch` (sibling) — double-buffered
   device staging of the next round's per-client batches.
+- :mod:`blades_tpu.perf.autotune` — the execution autotuner: measured
+  plan selection over the round pipeline's perf levers (execution
+  path, streamed ``d_chunk``, lane packing, MXU finish, scan windows,
+  prefetch) with a persistent on-disk plan cache.  See the README
+  "Execution autotuner" section.
 """
 
 from blades_tpu.perf.async_metrics import flush_rows  # noqa: F401
+from blades_tpu.perf.autotune import (  # noqa: F401
+    Plan,
+    PlanCache,
+    PlanSpace,
+    apply_plan,
+    enumerate_plans,
+    select_plan,
+    timed_measure_fn,
+)
 from blades_tpu.perf.compile_cache import (  # noqa: F401
     CachedFunction,
     cache_stats,

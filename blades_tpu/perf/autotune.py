@@ -1,0 +1,551 @@
+"""Execution autotuner: measured plan selection for the round pipeline.
+
+The repo accumulated a deep stack of perf levers — ``execution="auto"``,
+``client_packing="auto"``, ``scan_window="auto"``, the streamed
+``d_chunk``, the pallas MXU-finish variants — each resolved by its own
+hand-written heuristic that has never been validated against a
+measurement.  This module replaces that scatter with one measured
+decision, the way XLA-era systems pick tilings: enumerate the legal
+space, time the candidates, cache the winner.
+
+Three pieces:
+
+- **Plan space** (:class:`Plan`, :func:`enumerate_plans`): legal
+  candidates derived from the constraints already encoded at validate
+  time, partitioned into a **numerics-preserving default tier** (knobs
+  the existing equivalence tests prove bit-exact: streamed chunk sizes
+  on chunk-invariant rounds, the bit-exact MXU radix counts, chained
+  scan windows, prefetch) and an opt-in **reassociating tier**
+  (dense<->streamed<->packed switches and the ``stats_mxu`` finish,
+  which carry the documented float-reassociation tolerances).  A run
+  that never opts in can only be handed a plan that reproduces the
+  untuned trajectory bit for bit.
+- **Trial harness** (:func:`timed_measure_fn`, :func:`select_plan`):
+  each candidate compiles through the PR 3 AOT executable cache (the
+  candidate's resolved knobs ARE its compile-cache fingerprint), runs
+  ``warmup`` dispatches and reports the median of ``reps`` timed ones
+  on the donated-buffer pipeline.  When timing is unavailable — the
+  CPU tier-1 environment, or no measure function injected — selection
+  falls back to the **deterministic ranked heuristic**: candidates are
+  enumerated in the current resolution order, so rank 0 is exactly the
+  plan today's hand-written heuristics produce and off-TPU selection is
+  reproducible.  Tests inject a fake clock through ``clock=`` to drive
+  the timed path deterministically.
+- **Plan cache** (:class:`PlanCache`): winners persist to disk keyed
+  ``(config fingerprint, autotune tier, device kind, jaxlib version)``
+  using the :mod:`blades_tpu.faults.host` atomic write pattern (tmp +
+  fsync + ``os.replace``).  Entries are version-stamped and
+  corrupt-tolerant: a torn/garbage/stale file means re-tune, never a
+  crash.  ``tools/show_plan.py`` dumps and invalidates entries.
+
+The driver integration lives in
+:meth:`blades_tpu.algorithms.fedavg.Fedavg._resolve_autotune_plan`; the
+resolved plan plus per-candidate timings and the cache hit/miss flag
+flow into sweep summaries (``summary["autotune"]``) and the
+schema-registered round fields (``plan_id`` /
+``autotune_cache_hit`` / ``autotune_timed`` / ``autotune_candidates``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import statistics
+import time
+import warnings
+from pathlib import Path
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+PLAN_CACHE_VERSION = 1
+ENV_CACHE_DIR = "BLADES_TPU_PLAN_CACHE_DIR"
+_DEFAULT_CACHE_DIR = "~/.cache/blades_tpu/plans"
+
+# Streamed d_chunk candidates around the historical hard-coded default
+# (1 << 17).  Small on purpose: the chunk knob trades scan-carry size
+# against dispatch count, and the knee sits within one octave of the
+# default on every geometry measured so far.
+D_CHUNK_LADDER = (1 << 16, 1 << 17, 1 << 18)
+
+# Enumeration ceiling.  The knob grid is small by construction, but a
+# pathological composition (reassociating tier x windows x ladder) must
+# not turn one trial's tuning into a compile marathon; the drop count is
+# recorded in the provenance so the cap is never silent.
+MAX_CANDIDATES = 32
+
+DEFAULT_TIER = "default"
+REASSOCIATING_TIER = "reassociating"
+
+
+@dataclasses.dataclass(frozen=True)
+class Plan:
+    """One resolved execution configuration for the round pipeline.
+
+    Every field materialises a knob the ``"auto"`` heuristics used to
+    resolve independently; :func:`apply_plan` writes them back into a
+    :class:`~blades_tpu.algorithms.config.FedavgConfig` before the
+    driver builds its dispatch pipeline.
+    """
+
+    execution: str = "dense"          # resolved path — never "auto"
+    d_chunk: int = 1 << 17            # streamed finish chunk width
+    client_packing: int = 1           # clients per grouped-kernel lane
+    mxu_finish: str = ""              # "" | "counts" | "all" (streamed)
+    rounds_per_dispatch: int = 1      # chained scan window; 1 = per-round
+    prefetch: bool = False            # dense single-round batch staging
+    tier: str = DEFAULT_TIER          # numerics tier this plan belongs to
+
+    def __post_init__(self):
+        if self.execution not in ("dense", "streamed"):
+            raise ValueError(f"plan execution must be dense|streamed, "
+                             f"got {self.execution!r}")
+        if self.mxu_finish not in ("", "counts", "all"):
+            raise ValueError(f"plan mxu_finish must be ''|'counts'|'all', "
+                             f"got {self.mxu_finish!r}")
+        if self.tier not in (DEFAULT_TIER, REASSOCIATING_TIER):
+            raise ValueError(f"unknown plan tier {self.tier!r}")
+        if int(self.d_chunk) < 1024:
+            raise ValueError(f"plan d_chunk must be >= 1024, "
+                             f"got {self.d_chunk}")
+        if int(self.client_packing) < 1:
+            raise ValueError(f"plan client_packing must be >= 1, "
+                             f"got {self.client_packing}")
+        if int(self.rounds_per_dispatch) < 1:
+            raise ValueError(f"plan rounds_per_dispatch must be >= 1, "
+                             f"got {self.rounds_per_dispatch}")
+
+    @property
+    def plan_id(self) -> str:
+        """Compact stable identifier, stamped per round (``plan_id``)."""
+        return (f"{self.execution}|c{int(self.d_chunk)}"
+                f"|p{int(self.client_packing)}"
+                f"|mxu={self.mxu_finish or 'off'}"
+                f"|w{int(self.rounds_per_dispatch)}"
+                f"|{'pre' if self.prefetch else 'nopre'}")
+
+    def as_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Plan":
+        """Parse a plan dict (checkpoint payloads, cache entries, the
+        ``tuned_plan`` config pin).  Unknown keys raise — a cache entry
+        written by a FUTURE plan layout must read as stale, not be
+        half-applied."""
+        if not isinstance(d, dict):
+            raise ValueError(f"plan must be a dict, got {type(d).__name__}")
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = sorted(set(d) - known)
+        if unknown:
+            raise ValueError(f"unknown plan fields {unknown}")
+        return cls(**d)
+
+
+def apply_plan(config, plan: Plan) -> None:
+    """Materialise ``plan`` into the config's knob fields (the driver
+    then builds its pipeline from those exactly as an untuned run
+    would).  Composition contract: a knob the user set EXPLICITLY was
+    never varied by the plan space, so writing the plan back either
+    repeats the user's value or resolves an ``"auto"``.
+    """
+    config.execution = plan.execution
+    config.d_chunk = int(plan.d_chunk)
+    if plan.execution == "dense":
+        config.client_packing = (int(plan.client_packing)
+                                 if plan.client_packing >= 2 else "off")
+        if plan.rounds_per_dispatch == 1:
+            config.prefetch = bool(plan.prefetch)
+    else:
+        config.client_packing = "off"
+        config.mxu_finish = plan.mxu_finish
+    rpd = int(plan.rounds_per_dispatch)
+    prior = int(getattr(config, "rounds_per_dispatch", 1) or 1)
+    config.rounds_per_dispatch = rpd
+    if rpd > 1 and prior != rpd:
+        # The chained key discipline is what makes windowed rows
+        # bit-identical to round-per-dispatch execution (PR 3); every
+        # window the plan space INTRODUCES comes from the sweep's
+        # eligibility gate, which only ever engages chained windows.  A
+        # window the USER pinned (prior == rpd — the plan space never
+        # varies it) keeps the user's own chained_dispatch setting: the
+        # plain multi_step discipline is a legal explicit choice the
+        # tuner must not silently rewrite.
+        config.chained_dispatch = True
+
+
+# ---------------------------------------------------------------------------
+# plan-space enumeration
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class PlanSpace:
+    """Ordered candidate plans.  ``candidates[0]`` is ALWAYS the plan
+    the current hand-written heuristics resolve (the heuristic-fallback
+    winner); the rest follow in deterministic enumeration order.
+    ``truncated`` counts candidates dropped by :data:`MAX_CANDIDATES`.
+    """
+
+    candidates: Tuple[Plan, ...]
+    truncated: int = 0
+
+    @property
+    def baseline(self) -> Plan:
+        return self.candidates[0]
+
+
+def enumerate_plans(
+    *,
+    executions: Sequence[str],
+    d_chunks: Sequence[int],
+    mxu_modes: Sequence[str] = ("",),
+    pack_factors: Sequence[int] = (1,),
+    scan_windows: Sequence[int] = (1,),
+    prefetch_options: Sequence[bool] = (False,),
+    allow_reassociating: bool = False,
+    max_candidates: int = MAX_CANDIDATES,
+) -> PlanSpace:
+    """Enumerate legal plans from per-knob candidate lists.
+
+    Every list is ordered **baseline value first** — the caller derives
+    the lists from the config's constraints (explicit settings collapse
+    a list to one entry) — so the nested enumeration yields the current
+    heuristic resolution as ``candidates[0]`` by construction.
+
+    Tier assignment: switching the execution path, packing clients, or
+    enabling the ``stats_mxu`` finish ("all") reassociates float
+    reductions and lands in :data:`REASSOCIATING_TIER`; chunk sizes,
+    the bit-exact radix counts ("counts"), chained scan windows and
+    prefetch stay :data:`DEFAULT_TIER`.  Without
+    ``allow_reassociating`` the reassociating tier is not enumerated at
+    all — an un-opted run can never be handed one.
+    """
+    if not executions:
+        raise ValueError("executions must name at least the baseline path")
+    if not d_chunks:
+        raise ValueError("d_chunks must hold at least the baseline chunk")
+    plans: List[Plan] = []
+    for exe in executions:
+        exe_tier = DEFAULT_TIER if exe == executions[0] else REASSOCIATING_TIER
+        for w in scan_windows:
+            if exe == "streamed":
+                for dc in d_chunks:
+                    for mxu in mxu_modes:
+                        tier = exe_tier
+                        if mxu == "all" and mxu_modes[0] != "all":
+                            tier = REASSOCIATING_TIER
+                        plans.append(Plan(
+                            execution="streamed", d_chunk=int(dc),
+                            client_packing=1, mxu_finish=mxu,
+                            rounds_per_dispatch=int(w), prefetch=False,
+                            tier=tier))
+            else:
+                for p in pack_factors:
+                    tier = exe_tier
+                    if p != pack_factors[0]:
+                        tier = REASSOCIATING_TIER
+                    pres = prefetch_options if int(w) == 1 else (False,)
+                    for pre in pres:
+                        plans.append(Plan(
+                            execution="dense", d_chunk=int(d_chunks[0]),
+                            client_packing=int(p), mxu_finish="",
+                            rounds_per_dispatch=int(w), prefetch=bool(pre),
+                            tier=tier))
+    if not allow_reassociating:
+        plans = [p for p in plans if p.tier == DEFAULT_TIER]
+    # Dedupe preserving order (e.g. a chunk ladder whose entries clamp
+    # to the same effective width on a small model).
+    plans = list(dict.fromkeys(plans))
+    truncated = max(0, len(plans) - max_candidates)
+    if truncated:
+        plans = plans[:max_candidates]
+    return PlanSpace(candidates=tuple(plans), truncated=truncated)
+
+
+# ---------------------------------------------------------------------------
+# trial harness
+# ---------------------------------------------------------------------------
+
+
+def timing_available() -> bool:
+    """Whether wall-clock candidate trials mean anything here: the
+    single-threaded CPU backend (tier-1, laptops) measures compile +
+    interpreter noise, not the dispatch pipeline — selection there uses
+    the deterministic heuristic ranking instead."""
+    import jax
+
+    try:
+        return jax.default_backend() != "cpu"
+    except Exception:
+        return False
+
+
+def timed_measure_fn(
+    config,
+    *,
+    warmup: int = 1,
+    reps: int = 3,
+    clock: Optional[Callable[[], float]] = None,
+    build: Optional[Callable[[Any], Any]] = None,
+) -> Callable[[Plan], Optional[float]]:
+    """Build the measured-trial function: plan -> median seconds per
+    **FL round** (or ``None`` when the candidate fails to build).
+
+    One ``train()`` dispatch advances ``plan.rounds_per_dispatch``
+    rounds, so the raw dispatch median is divided by the window width —
+    otherwise a w=8 scan-window candidate would measure ~8x a w=1
+    candidate's dispatch and the tuner could never select a window.
+
+    The candidate config is a copy with ``autotune`` disabled and the
+    plan materialised, so it compiles through the PR 3 executable cache
+    under the SAME fingerprint the winning plan's real run will use —
+    the tuning compile is the run's compile.  ``clock`` is injectable
+    (tests drive the timed path with a fake, deterministic clock);
+    ``build`` defaults to ``candidate.build()``.
+    """
+    clock = clock or time.perf_counter
+    if warmup < 0 or reps < 1:
+        raise ValueError(f"need warmup >= 0, reps >= 1; got {warmup}/{reps}")
+
+    def measure(plan: Plan) -> Optional[float]:
+        cand = config.copy()
+        cand.autotune = False
+        cand.tuned_plan = None
+        cand._autotune_windows = None
+        apply_plan(cand, plan)
+        algo = None
+        try:
+            algo = build(cand) if build is not None else cand.build()
+            for _ in range(warmup):
+                algo.train()
+            times = []
+            for _ in range(reps):
+                t0 = clock()
+                algo.train()
+                times.append(clock() - t0)
+        except Exception as exc:
+            # A candidate that fails to build/run is ranked out, loudly:
+            # silence here would hide a plan-space bug behind "the other
+            # plan happened to win".
+            warnings.warn(
+                f"autotune candidate {plan.plan_id} failed and was "
+                f"skipped: {type(exc).__name__}: {exc}", RuntimeWarning)
+            return None
+        finally:
+            if algo is not None and callable(getattr(algo, "stop", None)):
+                algo.stop()
+        return float(statistics.median(times)) / max(
+            1, int(plan.rounds_per_dispatch))
+
+    return measure
+
+
+def select_plan(
+    space: PlanSpace,
+    *,
+    measure_fn: Optional[Callable[[Plan], Optional[float]]] = None,
+) -> Tuple[Plan, Dict[str, Any]]:
+    """Pick the winner from ``space``.
+
+    With a ``measure_fn``: every candidate is measured, the fastest
+    median wins (heuristic rank breaks exact ties, so selection is
+    deterministic under an injected clock).  Without one — or when
+    every measurement fails — the deterministic ranked heuristic wins:
+    ``space.candidates[0]``, the plan the current resolution order
+    produces, marked ``"mode": "heuristic"`` in the provenance.
+    """
+    timings: List[Optional[float]] = []
+    if measure_fn is not None:
+        for plan in space.candidates:
+            timings.append(measure_fn(plan))
+    else:
+        timings = [None] * len(space.candidates)
+    measured = [(t, i) for i, t in enumerate(timings) if t is not None]
+    if measured:
+        _, win = min(measured)
+        mode, timed = "measured", True
+    else:
+        win, mode, timed = 0, "heuristic", False
+    winner = space.candidates[win]
+    provenance = {
+        "mode": mode,                  # "measured" | "heuristic"
+        "timed": timed,
+        "cache_hit": False,
+        "winner": winner.as_dict(),
+        "winner_id": winner.plan_id,
+        "candidates": [
+            {"rank": i, "plan_id": p.plan_id, "tier": p.tier,
+             "median_s": timings[i]}
+            for i, p in enumerate(space.candidates)
+        ],
+        "truncated": space.truncated,
+    }
+    return winner, provenance
+
+
+# ---------------------------------------------------------------------------
+# persistent plan cache
+# ---------------------------------------------------------------------------
+
+
+def cache_key(
+    config_fingerprint: str,
+    tier: str = DEFAULT_TIER,
+    device_kind: Optional[str] = None,
+    jaxlib_version: Optional[str] = None,
+) -> Dict[str, str]:
+    """The plan-cache key: a plan tuned for one program on one device
+    generation under one compiler is evidence about exactly that.  The
+    config fingerprint already excludes ``seed`` (a seed grid shares
+    one plan) and the autotune fields themselves; ``tier`` keeps a
+    reassociating-tier winner from ever serving a default-tier run.
+    """
+    if device_kind is None:
+        import jax
+
+        try:
+            dev = jax.devices()[0]
+            device_kind = str(getattr(dev, "device_kind", None)
+                              or dev.platform)
+        except Exception:
+            device_kind = "unknown"
+    if jaxlib_version is None:
+        try:
+            import jaxlib
+
+            jaxlib_version = str(jaxlib.__version__)
+        except Exception:
+            import jax
+
+            jaxlib_version = str(getattr(jax, "__version__", "unknown"))
+    return {
+        "fingerprint": str(config_fingerprint),
+        "tier": str(tier),
+        "device_kind": device_kind,
+        "jaxlib": jaxlib_version,
+    }
+
+
+class PlanCache:
+    """On-disk winner cache: one JSON file per key under ``cache_dir``
+    (``$BLADES_TPU_PLAN_CACHE_DIR`` or ``~/.cache/blades_tpu/plans``).
+
+    Durability follows :func:`blades_tpu.faults.host.atomic_checkpoint`
+    scaled down to a file: write ``<entry>.json.tmp``, fsync, one
+    ``os.replace``.  A SIGKILL mid-write leaves either the previous
+    entry or an orphaned ``.tmp`` that the next read deletes — never a
+    torn entry.  Reads are corrupt-tolerant by contract: any
+    undecodable / version-stale / key-mismatched / unparsable-plan file
+    is treated as a miss (re-tune), never an exception.
+    """
+
+    def __init__(self, cache_dir=None):
+        cache_dir = (cache_dir
+                     or os.environ.get(ENV_CACHE_DIR)
+                     or _DEFAULT_CACHE_DIR)
+        self.dir = Path(cache_dir).expanduser()
+
+    @staticmethod
+    def digest(key: Dict[str, str]) -> str:
+        return hashlib.sha1(
+            json.dumps(key, sort_keys=True).encode()).hexdigest()
+
+    def _path(self, key: Dict[str, str]) -> Path:
+        return self.dir / f"{self.digest(key)}.json"
+
+    def get(self, key: Dict[str, str]) -> Optional[Dict[str, Any]]:
+        """The cached entry for ``key``, or ``None`` (miss / corrupt /
+        stale / mismatched).  Also deletes this key's orphaned ``.tmp``
+        (a writer killed before its ``os.replace``)."""
+        path = self._path(key)
+        tmp = path.with_name(path.name + ".tmp")
+        if tmp.exists():
+            try:
+                tmp.unlink()
+            except OSError:
+                pass
+        entry = self._read_entry(path)
+        if entry is None:
+            return None
+        if entry.get("key") != key:
+            # sha1 collision or a hand-moved file: the stored key is the
+            # source of truth, the filename just locates it.
+            return None
+        return entry
+
+    @staticmethod
+    def _read_entry(path: Path) -> Optional[Dict[str, Any]]:
+        try:
+            entry = json.loads(path.read_text())
+        except (OSError, json.JSONDecodeError, UnicodeDecodeError):
+            return None
+        if not isinstance(entry, dict):
+            return None
+        if entry.get("version") != PLAN_CACHE_VERSION:
+            return None
+        try:
+            Plan.from_dict(entry.get("plan"))
+        except (ValueError, TypeError):
+            return None
+        return entry
+
+    def put(self, key: Dict[str, str], plan: Plan,
+            provenance: Optional[Dict[str, Any]] = None) -> Optional[str]:
+        """Persist a winner atomically; returns the entry path, or
+        ``None`` when the filesystem refuses (an unwritable cache must
+        degrade to tune-per-process, never fail the trial)."""
+        entry = {
+            "version": PLAN_CACHE_VERSION,
+            "key": dict(key),
+            "plan": plan.as_dict(),
+            "provenance": dict(provenance or {}),
+            "created_unix": time.time(),
+        }
+        path = self._path(key)
+        tmp = path.with_name(path.name + ".tmp")
+        try:
+            self.dir.mkdir(parents=True, exist_ok=True)
+            with open(tmp, "w") as f:
+                json.dump(entry, f, indent=2, sort_keys=True)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except OSError as exc:
+            warnings.warn(f"plan cache write failed ({exc}); the plan "
+                          "will be re-tuned next process", RuntimeWarning)
+            try:
+                tmp.unlink()
+            except OSError:
+                pass
+            return None
+        return str(path)
+
+    def entries(self) -> List[Tuple[str, Optional[Dict[str, Any]]]]:
+        """Every ``(digest, entry-or-None)`` in the cache dir, sorted;
+        ``None`` marks a file the tolerant reader rejected (corrupt or
+        stale-version) — surfaced so ``tools/show_plan.py`` can report
+        rather than hide them."""
+        if not self.dir.is_dir():
+            return []
+        out = []
+        for p in sorted(self.dir.glob("*.json")):
+            out.append((p.stem, self._read_entry(p)))
+        return out
+
+    def invalidate(self, digest: Optional[str] = None) -> List[str]:
+        """Delete one entry by digest, or every entry (and orphaned
+        ``.tmp``) when ``digest`` is None.  Returns the removed names."""
+        if not self.dir.is_dir():
+            return []
+        removed = []
+        pats = ([f"{digest}.json", f"{digest}.json.tmp"] if digest
+                else ["*.json", "*.json.tmp"])
+        for pat in pats:
+            for p in sorted(self.dir.glob(pat)):
+                try:
+                    p.unlink()
+                    removed.append(p.name)
+                except OSError:
+                    pass
+        return removed

@@ -49,6 +49,25 @@ def main(argv=None) -> int:
                         help="enable JAX's persistent compilation cache in "
                         "DIR so repeat sweeps skip XLA entirely (also via "
                         "$BLADES_TPU_COMPILE_CACHE_DIR)")
+    common.add_argument("--autotune", nargs="?", const="on", default=None,
+                        choices=("on", "reassociating"),
+                        help="execution autotuner (perf/autotune.py): "
+                        "enumerate the legal execution plans, time them on "
+                        "TPU (deterministic ranked heuristic on CPU), cache "
+                        "the winner.  Bare --autotune tunes the numerics-"
+                        "preserving default tier (bit-identical to the "
+                        "untuned path); '--autotune reassociating' also "
+                        "offers dense<->streamed<->packed switches and the "
+                        "stats-MXU finish (documented float tolerances).  "
+                        "Explicit knobs (--client-packing, execution, "
+                        "d_chunk, --scan-window N) are never overridden — "
+                        "the tuner only resolves what was left at 'auto'; "
+                        "see README \"Execution autotuner\"")
+    common.add_argument("--plan-cache-dir", default=None, metavar="DIR",
+                        help="persistent plan-cache location for --autotune "
+                        "(default $BLADES_TPU_PLAN_CACHE_DIR or "
+                        "~/.cache/blades_tpu/plans); inspect with "
+                        "python -m tools.show_plan")
     common.add_argument("-v", "--verbose", action="count", default=1)
 
     p_file = sub.add_parser("file", parents=[common],
@@ -144,6 +163,8 @@ def main(argv=None) -> int:
                 metrics_every=args.metrics_every,
                 scan_window=scan_window,
                 compile_cache_dir=args.compile_cache,
+                autotune=args.autotune,
+                plan_cache_dir=args.plan_cache_dir,
             )
 
     else:
@@ -170,6 +191,8 @@ def main(argv=None) -> int:
                 metrics_every=args.metrics_every,
                 scan_window=scan_window,
                 compile_cache_dir=args.compile_cache,
+                autotune=args.autotune,
+                plan_cache_dir=args.plan_cache_dir,
             )
 
     # --trace wraps EITHER subcommand (the run subcommand used to silently

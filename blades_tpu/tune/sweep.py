@@ -175,6 +175,13 @@ def _lanes_eligible(spec_run: str, trial: Dict, group: List[int]) -> bool:
         # Same for the chaos layer: the laned program has no fault
         # injection, so a faulted trial would silently run failure-free.
         return False
+    if getattr(cfg, "autotune_mode", None):
+        # The vmapped lane program has no plan machinery — an autotuned
+        # trial runs sequentially so its plan resolution, provenance
+        # stamps and checkpoint plan record all engage.  (The NORMALIZED
+        # mode, not the raw value: an explicit autotune: "off" must not
+        # knock its lane group back to sequential execution.)
+        return False
     if cfg.lr_schedule:
         _, ov = _lane_signature(trial)
         if "server_lr" in ov:
@@ -224,26 +231,30 @@ def _trial_name(base: str, idx: int, trial_cfg: Dict) -> str:
 _SCAN_WINDOW_CAP = 8
 
 
-def _auto_scan_window(config, max_rounds: int, checkpoint_freq: int,
-                      cap: int = _SCAN_WINDOW_CAP) -> int:
-    """Largest dispatch window ``w`` (``<= cap``) whose windowed execution
-    is OBSERVABLY identical to round-per-dispatch: ``w`` must divide the
-    round budget (no overshoot past the stop criterion), the eval
-    interval (evaluations land on the same rounds, against the same
-    state), and the checkpoint frequency (checkpoints can only fire on
-    dispatch boundaries).  Trials where the user pinned
-    ``rounds_per_dispatch`` keep their setting; forensics trials stay
+def _eligible_scan_windows(config, max_rounds: int, checkpoint_freq: int,
+                           cap: int = _SCAN_WINDOW_CAP) -> Tuple[int, ...]:
+    """Every dispatch window ``w`` (``<= cap``, descending, 1 last)
+    whose windowed execution is OBSERVABLY identical to
+    round-per-dispatch: ``w`` must divide the round budget (no
+    overshoot past the stop criterion), the eval interval (evaluations
+    land on the same rounds, against the same state), and the
+    checkpoint frequency (checkpoints can only fire on dispatch
+    boundaries).  Trials where the user pinned ``rounds_per_dispatch``
+    offer no windows (they keep their setting); forensics trials stay
     sequential (their per-lane bundles are reported per dispatch).
-    Returns 1 when no window qualifies."""
+    The head of this list is the classic ``scan_window="auto"`` pick;
+    the whole list is the execution autotuner's window candidate set.
+    """
     if int(getattr(config, "rounds_per_dispatch", 1) or 1) != 1:
-        return 1
+        return (1,)
     if getattr(config, "forensics", False):
-        return 1
+        return (1,)
     if getattr(config, "num_devices", None):
-        return 1
+        return (1,)
     if getattr(config, "execution", "auto") not in ("auto", "dense"):
-        return 1
+        return (1,)
     interval = int(getattr(config, "evaluation_interval", 0) or 0)
+    out = []
     for w in range(min(cap, max_rounds), 1, -1):
         if max_rounds % w:
             continue
@@ -251,8 +262,41 @@ def _auto_scan_window(config, max_rounds: int, checkpoint_freq: int,
             continue
         if checkpoint_freq and checkpoint_freq % w:
             continue
-        return w
-    return 1
+        out.append(w)
+    out.append(1)
+    return tuple(out)
+
+
+def _auto_scan_window(config, max_rounds: int, checkpoint_freq: int,
+                      cap: int = _SCAN_WINDOW_CAP) -> int:
+    """Largest eligible dispatch window (see
+    :func:`_eligible_scan_windows`); 1 when no window qualifies."""
+    return _eligible_scan_windows(config, max_rounds, checkpoint_freq,
+                                  cap)[0]
+
+
+def _pin_checkpoint_plan(config, tdir: Path) -> None:
+    """Pin an autotuned trial's execution plan to the one its latest
+    checkpoint was written under (``config.tuned_plan``), so a
+    retry/resume REPLAYS the identical plan instead of silently
+    re-tuning mid-trajectory (the plan cache may have been invalidated
+    or re-measured since the trial started).  No-op without autotune, a
+    checkpoint, or a recorded plan."""
+    if not getattr(config, "autotune_mode", None):
+        return
+    ckpt = _latest_checkpoint(tdir)
+    if ckpt is None:
+        return
+    import pickle
+
+    p = ckpt / "algorithm_state.pkl"
+    try:
+        with open(p, "rb") as f:
+            plan = pickle.load(f).get("plan")
+    except Exception:
+        return  # unreadable checkpoint: restore itself will surface it
+    if plan:
+        config.tuned_plan = plan
 
 
 def _read_results(path: Path) -> List[Dict]:
@@ -540,6 +584,8 @@ def run_experiments(
     scan_window="auto",
     metrics_every: int = 1,
     compile_cache_dir: Optional[str] = None,
+    autotune=None,
+    plan_cache_dir: Optional[str] = None,
 ) -> List[Dict]:
     """Run every trial of every experiment; returns summaries.
 
@@ -568,6 +614,20 @@ def run_experiments(
       executable cache, whose per-trial hit/miss deltas land in each
       summary under ``compile_cache`` (and per round in the metrics
       stream as ``compile_cache_hits``/``compile_cache_misses``).
+    - ``autotune`` (the CLI's ``--autotune``): enable the execution
+      autotuner (:mod:`blades_tpu.perf.autotune`) on every trial that
+      does not set its own ``autotune`` config — ``True``/``"on"`` for
+      the numerics-preserving default tier, ``"reassociating"`` to also
+      offer the opt-in tier.  Autotuned trials run sequentially (never
+      laned), own their dispatch window (the sweep hands the eligible
+      chained windows to the plan space instead of pre-resolving
+      ``scan_window="auto"`` itself), stamp plan provenance into their
+      round rows, and surface the full selection record in the summary
+      under ``"autotune"``.  Retries and resumes PIN the plan recorded
+      in the latest checkpoint (``config.tuned_plan``) so a restored
+      trajectory replays the identical plan instead of re-tuning.
+      ``plan_cache_dir`` points the persistent plan cache somewhere
+      other than ``$BLADES_TPU_PLAN_CACHE_DIR`` / the default.
 
     **Metrics pipeline** (obs subsystem): every trial also streams one
     schema-validated JSONL record per round to ``<trial>/metrics.jsonl``
@@ -648,6 +708,19 @@ def run_experiments(
     from blades_tpu.utils.timers import Timers
 
     enable_persistent_compilation_cache(compile_cache_dir)
+
+    def _apply_autotune(config) -> bool:
+        """Apply the sweep-level autotune request to a trial config
+        (trial-level settings win) and report whether the trial is
+        autotuned."""
+        if autotune and not getattr(config, "autotune", False):
+            config.autotune = (autotune if isinstance(autotune, str)
+                               else True)
+        if plan_cache_dir and not getattr(config, "autotune_cache_dir",
+                                          None):
+            config.autotune_cache_dir = plan_cache_dir
+        return bool(getattr(config, "autotune_mode", None))
+
     preempt_hook = PreemptionHook(preempt_after) if preempt_after else None
     # Scan windows change dispatch boundaries, which is only safe to do
     # implicitly on a fresh straight-line sweep: resume/retries can land
@@ -670,7 +743,8 @@ def run_experiments(
         laned: Dict[int, Dict] = {}
         lane_failed: Dict[int, str] = {}
         if (lanes and not resume and not checkpoint_freq
-                and not checkpoint_at_end and max_failures == 0):
+                and not checkpoint_at_end and max_failures == 0
+                and not autotune):
             for group in lane_groups(trials):
                 if not _lanes_eligible(spec["run"], trials[group[0]], group):
                     continue
@@ -732,16 +806,34 @@ def run_experiments(
                 continue
             algo_cls, config = get_algorithm_class(spec["run"], return_config=True)
             config.update_from_dict(trial_cfg)
+            autotuned = _apply_autotune(config)
             scan_w = (_auto_scan_window(config, max_rounds, checkpoint_freq,
                                         window_cap) if windows_ok else 1)
-            if scan_w > 1:
+            if autotuned:
+                # The execution autotuner owns the dispatch window for
+                # this trial: hand it the whole eligible set instead of
+                # pre-resolving scan_window="auto" here, and read the
+                # effective window off the resolved plan after build.
+                if windows_ok:
+                    config._autotune_windows = _eligible_scan_windows(
+                        config, max_rounds, checkpoint_freq, window_cap)
+                scan_w = 1
+            elif scan_w > 1:
                 # Windowed dispatch with the driver's key discipline
                 # (chained_dispatch): rows stay bit-identical to
                 # round-per-dispatch execution, checkpoints included.
                 config.rounds_per_dispatch = scan_w
                 config.chained_dispatch = True
             cache_before = cache_stats()
+            if resume and autotuned:
+                # Replay the checkpointed plan, never re-tune a
+                # restored trajectory (see _pin_checkpoint_plan).
+                _pin_checkpoint_plan(config, tdir)
             algo = config.build()
+            if autotuned:
+                plan = getattr(algo, "plan", None)
+                if plan is not None:
+                    scan_w = int(plan.rounds_per_dispatch)
             resumed_from = None
             if resume:
                 ckpt = _latest_checkpoint(tdir)
@@ -910,6 +1002,14 @@ def run_experiments(
                     # reference's restart-from-checkpoint trial retry.
                     _, config = get_algorithm_class(spec["run"], return_config=True)
                     config.update_from_dict(trial_cfg)
+                    if _apply_autotune(config):
+                        # A restarted autotuned trial replays the plan its
+                        # latest checkpoint recorded — the cache may have
+                        # been re-measured since the trial started, and a
+                        # new winner mid-trajectory is exactly the silent
+                        # re-tune drift the checkpoint record exists to
+                        # prevent.
+                        _pin_checkpoint_plan(config, tdir)
                     algo = config.build()
                     compiled = False  # fresh build recompiles
                     ckpt = _latest_checkpoint(tdir)
@@ -972,6 +1072,15 @@ def run_experiments(
                 summary["packing"] = packing
             if scan_w > 1:
                 summary["scan_window"] = scan_w
+            plan_summary = getattr(algo, "plan_summary", None)
+            if plan_summary:
+                # Execution-autotuner provenance (perf/autotune.py):
+                # selection mode (measured / heuristic / cache / pinned),
+                # the full candidate list with per-candidate timings (or
+                # None medians under the heuristic fallback), the winner
+                # and the cache hit/miss flag — the complete selection
+                # record the round rows only carry scalars of.
+                summary["autotune"] = _jsonable(plan_summary)
             if (cost_analysis and failed_error is None
                     and hasattr(algo, "cost_analysis")):
                 cost = algo.cost_analysis()

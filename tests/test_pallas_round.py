@@ -372,6 +372,42 @@ def test_mxu_finish_env_resolved_per_call(monkeypatch):
     assert seen[-1] == (False, False)
 
 
+def test_mxu_finish_config_path_resolved_per_call(monkeypatch):
+    """The first-class ``resources(mxu_finish=...)`` path (ISSUE 10
+    satellite, extending the PR 4 toggle test): with the env UNSET the
+    caller's config-resolved ``mxu_finish`` string selects the mode per
+    call; a SET env var — even set AFTER the first call — overrides the
+    config value (the explicit per-process escape hatch)."""
+    from blades_tpu.ops import pallas_round
+
+    seen = []
+
+    def spy(updates, noise=None, **kw):
+        seen.append((kw["radix_mxu"], kw["stats_mxu"]))
+        return "sentinel"
+
+    monkeypatch.setattr(pallas_round, "_fused_finish_compact_jit", spy)
+    x = jnp.zeros((8, 600))
+    monkeypatch.delenv("BLADES_TPU_MXU_FINISH", raising=False)
+
+    for mode in ("", "counts", "all", None):
+        pallas_round.fused_finish_compact(
+            x, forged_mult=2, forge=("alie", 1.5), mxu_finish=mode)
+    assert seen == [(False, False), (True, False), (True, True),
+                    (False, False)]
+    # A SET env var beats the config value, toggled after first call.
+    monkeypatch.setenv("BLADES_TPU_MXU_FINISH", "all")
+    pallas_round.fused_finish_compact(
+        x, forged_mult=2, forge=("alie", 1.5), mxu_finish="counts")
+    assert seen[-1] == (True, True)
+    # Even env="" (set-but-empty) is an explicit override, not a fall-
+    # through to the config value.
+    monkeypatch.setenv("BLADES_TPU_MXU_FINISH", "")
+    pallas_round.fused_finish_compact(
+        x, forged_mult=2, forge=("alie", 1.5), mxu_finish="all")
+    assert seen[-1] == (False, False)
+
+
 def test_streamed_step_compact_branch_matches_chunked(monkeypatch):
     """Force the streamed round onto the benign-compacted fused finish
     (elided malicious prefix + virtual-multiplicity kernel, interpret
