@@ -518,6 +518,118 @@ def _rowgeom_block(cpu: bool) -> dict:
     return out
 
 
+def _measure_quantagg_round(domain: str, aggregator: str, *, model,
+                            input_shape, num_clients, num_byzantine,
+                            timed_rounds) -> dict:
+    """One aggregation-domain arm of the QUANTAGG A/B: the dense
+    protocol (FedAvg + ALIE forge + ``aggregator``) under the int8
+    quant codec, aggregating either decode-then-f32 (``domain="f32"``)
+    or in the packed wire domain (``domain="wire"`` —
+    ``Server.step_wire``).  Wire rounds additionally report the
+    planner's traversal counts and the per-round HBM byte estimate of
+    the defense-statistics traversals — ``hbm_passes * n * d *
+    bytes/elem``, the exact loop the wire domain shrinks — against the
+    SAME statistics at 4 bytes/elem (the f32 arm's dense aggregators
+    run one XLA program, so the planner's pass count is the
+    apples-to-apples traversal basis).  The rows that DO decode
+    (selected slices, coordinate-wise outputs, the forge's sanctioned
+    full read — f32-domain rounds touch those same f32 rows, they just
+    never had a counter) ride separately as ``dequant_bytes_est``."""
+    from blades_tpu.adversaries import get_adversary, make_malicious_mask
+    from blades_tpu.comm.codecs import CodecConfig
+    from blades_tpu.core import FedRound, Server, TaskSpec
+
+    task = TaskSpec(model=model, input_shape=input_shape, num_classes=10,
+                    lr=0.1).build()
+    server = Server.from_config(aggregator=aggregator,
+                                num_byzantine=num_byzantine, lr=0.5)
+    adv = get_adversary("ALIE", num_clients=num_clients,
+                        num_byzantine=num_byzantine)
+    fr = FedRound(task=task, server=server, adversary=adv,
+                  batch_size=min(BATCH, 8),
+                  num_batches_per_round=LOCAL_STEPS,
+                  codec=CodecConfig(name="quant", bits=8),
+                  agg_domain=domain)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(num_clients, 8, *input_shape)),
+                    jnp.float32)
+    y = jnp.asarray(rng.integers(0, 10, size=(num_clients, 8)), jnp.int32)
+    lengths = jnp.full((num_clients,), 8, jnp.int32)
+    mal = make_malicious_mask(num_clients, num_byzantine)
+    state = fr.init(jax.random.PRNGKey(0), num_clients)
+    step = jax.jit(fr.step, donate_argnums=(0,))
+    state, m = step(state, x, y, lengths, mal, jax.random.PRNGKey(1))
+    _ = float(m["train_loss"])  # compile + settle
+    t0 = time.perf_counter()
+    for r in range(timed_rounds):
+        state, m = step(state, x, y, lengths, mal,
+                        jax.random.fold_in(jax.random.PRNGKey(2), r))
+    final_loss = float(m["train_loss"])
+    assert final_loss == final_loss  # NaN guard
+    dt = time.perf_counter() - t0
+    d = sum(p.size for p in jax.tree.leaves(state.server.params))
+    out = {
+        "agg_domain": domain, "aggregator": aggregator,
+        "round_s": round(dt / timed_rounds, 4),
+        "rounds_per_sec": round(timed_rounds / dt, 4),
+        "clients": num_clients, "byzantine": num_byzantine,
+        "model": model, "params": d, "codec": "quant-int8",
+        "timed_rounds": timed_rounds,
+    }
+    if domain == "wire":
+        passes = int(m["hbm_passes"])
+        dequant = int(m["dequant_rows"])
+        out["hbm_passes"] = passes
+        out["hbm_passes_unfused"] = int(m["hbm_passes_unfused"])
+        out["dequant_rows"] = dequant
+        out["agg_domain_bits"] = 8
+        out["agg_hbm_bytes_est"] = passes * num_clients * d * 1
+        # The same statistics traversed as dense f32 — the f32 arm's
+        # apples-to-apples estimate, stamped here so the block can
+        # report the reduction without re-deriving pass counts.
+        out["agg_hbm_bytes_est_f32"] = passes * num_clients * d * 4
+        out["dequant_bytes_est"] = dequant * d * 4
+    return out
+
+
+def _quantagg_block(cpu: bool) -> dict:
+    """BLADES_BENCH_QUANTAGG satellite (ISSUE 11): f32-domain vs
+    wire-domain aggregation under the int8 quant codec on the dense
+    protocol — Median (the bench's coordinate-wise finish, exact in
+    either domain) and Multikrum (Gram geometry: the statistics that
+    ride the MXU's int8 path on kernel-eligible shapes).  Rides the
+    TPU-probe + cpu_fallback machinery like the packed/rowgeom A/Bs;
+    cpu_fallback numbers are comparable only with other cpu_fallback
+    rounds.  Alongside wall-times, each wire arm stamps the per-round
+    HBM byte estimate of the defense statistics vs the f32 equivalent
+    (the acceptance's >= ~2x reduction surfaces as
+    ``agg_hbm_reduction``)."""
+    if cpu:
+        cfg = dict(model="mlp", input_shape=(8, 8, 1), num_clients=32,
+                   num_byzantine=8, timed_rounds=2)
+    else:
+        cfg = dict(model="cnn", input_shape=(32, 32, 3), num_clients=32,
+                   num_byzantine=8, timed_rounds=3)
+    out = {}
+    for agg in ("Median", "Multikrum"):
+        f32 = _measure_quantagg_round("f32", agg, **cfg)
+        wire = _measure_quantagg_round("wire", agg, **cfg)
+        reduction = None
+        if wire.get("agg_hbm_bytes_est"):
+            reduction = round(wire["agg_hbm_bytes_est_f32"]
+                              / wire["agg_hbm_bytes_est"], 3)
+        speedup = None
+        if f32["rounds_per_sec"]:
+            speedup = round(wire["rounds_per_sec"] / f32["rounds_per_sec"],
+                            3)
+        out[agg.lower()] = {
+            "f32": f32, "wire": wire,
+            "agg_hbm_reduction": reduction,
+            "wire_speedup": speedup,
+        }
+    return out
+
+
 def _measure_autotuned(tuned: bool, plan_cache_dir: str, *, num_clients,
                        model, dataset, input_shape, timed_rounds) -> dict:
     """One config-driven run of the bench protocol through the FULL
@@ -650,6 +762,13 @@ def _cpu_fallback(probe_err: str) -> None:
             out["autotune"] = _autotune_block(cpu=True)
         except Exception as e:
             out["autotune"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+    if os.environ.get("BLADES_BENCH_QUANTAGG", "1") == "1":
+        try:
+            # Wire-domain aggregation A/B (ISSUE 11) on the reduced CPU
+            # config — decode-then-f32 vs packed-int8 defense geometry.
+            out["quantagg"] = _quantagg_block(cpu=True)
+        except Exception as e:
+            out["quantagg"] = {"error": f"{type(e).__name__}: {e}"[:300]}
     _emit(out)
 
 
@@ -738,6 +857,16 @@ def main() -> None:
             out["autotune"] = _autotune_block(cpu=False)
         except Exception as e:
             out["autotune"] = {"error": f"{type(e).__name__}: {e}"[:500]}
+
+    if os.environ.get("BLADES_BENCH_QUANTAGG", "1") == "1":
+        try:
+            # Wire-domain aggregation A/B (ISSUE 11): the 32-client CNN
+            # protocol under the int8 quant codec, decode-then-f32 vs
+            # packed-int8 defense geometry (Server.step_wire), with
+            # per-round HBM byte estimates next to the wall-times.
+            out["quantagg"] = _quantagg_block(cpu=False)
+        except Exception as e:
+            out["quantagg"] = {"error": f"{type(e).__name__}: {e}"[:500]}
 
     _emit(out)
 

@@ -159,6 +159,20 @@ class FedavgConfig:
         # program is then bit-identical to a codec-free build (and
         # {"type": "identity"} is a regression-tested no-op).
         self.codec_config: Optional[Dict] = None
+        # Aggregation domain under a codec: "f32" (default) decodes the
+        # wire payload to dense f32 before the defenses — bit-identical
+        # to the pre-wire-domain program; "wire" keeps quantized updates
+        # packed (int8 + per-row scales) through the defense statistics
+        # (Server.step_wire / streamed_geometry.aggregate_wire) — the
+        # hottest traversals read 1 byte/coordinate instead of 4, per-row
+        # scales apply algebraically, adversaries still forge post-codec
+        # (in the quantized domain; their rows re-enter the same wire).
+        # Requires a deferrable codec (identity/quant — identity is a
+        # regression-tested bit-identical pass-through), dense
+        # single-chip execution, and none of faults/health/forensics/DP.
+        # The autotuner's reassociating tier probes this knob
+        # (agg_domain in its plan space); the default tier never does.
+        self.agg_domain: str = "f32"
         # defense forensics (obs subsystem): per-lane aggregator telemetry
         # + Byzantine detection precision/recall/FPR emitted from inside
         # the jitted round; dense single-chip execution only
@@ -274,12 +288,15 @@ class FedavgConfig:
         detection precision/recall/FPR per round (obs subsystem)."""
         return self._set(forensics=forensics)
 
-    def communication(self, *, codec=None):
+    def communication(self, *, codec=None, agg_domain=None):
         """Compressed-update codec on the client->server uplink
         (``codec=`` a dict for :class:`blades_tpu.comm.CodecConfig`,
-        e.g. ``{"type": "topk", "topk_ratio": 0.01}``); see the README
-        "Communication codecs" section for the interaction matrix."""
-        return self._set(codec_config=codec)
+        e.g. ``{"type": "topk", "topk_ratio": 0.01}``) and the
+        aggregation domain (``agg_domain="f32"|"wire"`` — "wire" keeps
+        quantized payloads packed through the defense statistics); see
+        the README "Communication codecs" section for the interaction
+        matrix."""
+        return self._set(codec_config=codec, agg_domain=agg_domain)
 
     # -- dict shim (ref: algorithm_config.py:253-293,360-379) ----------------
 
@@ -444,6 +461,50 @@ class FedavgConfig:
                     "and per-row scales under shard_map would shard the "
                     "lane axis — run the compressed pass without "
                     "num_devices, or disable the codec"
+                )
+        if self.agg_domain not in ("f32", "wire"):
+            raise ValueError(
+                f"agg_domain must be 'f32' or 'wire', got "
+                f"{self.agg_domain!r}"
+            )
+        if self.agg_domain == "wire":
+            # Fail-fast discipline of faults/codecs: every structural
+            # impossibility surfaces here, not at trace time.
+            codec = self.get_codec()
+            if codec is None or not codec.supports_deferred:
+                raise ValueError(
+                    "agg_domain='wire' needs a deferrable codec "
+                    "(identity or quant int8/int4): the defense "
+                    "statistics traverse the PACKED wire payload, and "
+                    f"{'no codec' if codec is None else codec.name!r} has "
+                    "no packed-integer matrix to aggregate — set "
+                    ".communication(codec={'type': 'quant', ...}) or "
+                    "keep agg_domain='f32'"
+                )
+            for knob, why in (
+                (self.fault_config, "fault injection"),
+                (self.health_check, "the in-round health check"),
+                (self.forensics, "defense forensics"),
+                (self.dp_clip_threshold, "client DP"),
+            ):
+                if knob:
+                    raise ValueError(
+                        f"agg_domain='wire' cannot compose with {why}: "
+                        "those stages rewrite/inspect dense f32 rows the "
+                        "wire domain never materializes — run them under "
+                        "agg_domain='f32', or drop the feature"
+                    )
+            from blades_tpu.parallel.streamed_geometry import (
+                WIRE_AGGREGATORS,
+            )
+
+            agg = self.get_server().aggregator
+            if not isinstance(agg, WIRE_AGGREGATORS):
+                raise ValueError(
+                    f"aggregator {type(agg).__name__} has no wire-domain "
+                    "formulation (aggregate_wire covers "
+                    f"{sorted(c.__name__ for c in WIRE_AGGREGATORS)}); "
+                    "use agg_domain='f32'"
                 )
         if self.client_packing not in ("off", "auto", None):
             # Forced int P: structural impossibilities fail at validate()
@@ -657,6 +718,8 @@ class FedavgConfig:
             forensics=self.forensics,
             faults=self.get_fault_injector(),
             codec=self.get_codec(),
+            agg_domain=self.agg_domain,
+            agg_d_chunk=self.d_chunk,
         )
         # Client lane-packing: resolve "auto"/forced requests against the
         # built model (width heuristic, hook gates) — LOUD fallback under

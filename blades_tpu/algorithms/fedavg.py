@@ -580,8 +580,11 @@ class Fedavg:
 
         # Pack factors (dense only; packing reassociates the per-client
         # convolutions).  The resolved baseline comes first; alternates
-        # are probed through resolve_client_packing itself so only
-        # structurally-possible factors enter the space.  Composition
+        # {2, 4, 8} are probed through resolve_client_packing itself —
+        # the SAME resolver the static "auto" heuristic uses, so only
+        # structurally-possible factors enter the space (impossible ones
+        # drop at enumeration, never at apply time) and the measured
+        # tier can out-vote the heuristic's fixed P=2.  Composition
         # contract: a forced int pins trivially, and an EXPLICIT "off"
         # pins too — only "auto" (a standing request to resolve) or the
         # untouched default may be varied.
@@ -592,7 +595,7 @@ class Fedavg:
                      or "client_packing" not in explicit)):
             from blades_tpu.parallel.packed import resolve_client_packing
 
-            for p in (1, 2, 4):
+            for p in (1, 2, 4, 8):
                 if p in packs or cfg.num_clients % p:
                     continue
                 if p == 1:
@@ -629,10 +632,32 @@ class Fedavg:
         if cfg.prefetch == "auto" and "prefetch" not in explicit:
             prefetch_options.append(not base_pre)
 
+        # Aggregation domain (dense + codec only): the configured value
+        # is the baseline; the reassociating tier additionally offers
+        # the wire domain when the codec can defer (quant int8/int4 —
+        # identity's wire IS f32, so there is nothing to time) and no
+        # f32-domain-only stage (faults/health/forensics/DP) is
+        # configured.  Explicit agg_domain pins the list — the standard
+        # composition contract.
+        agg_domains = [cfg.agg_domain]
+        if (allow_reassociating and "dense" in execs
+                and "agg_domain" not in explicit
+                and cfg.agg_domain == "f32" and cfg.codec_config
+                and not (cfg.fault_config or cfg.health_check
+                         or cfg.forensics or cfg.dp_clip_threshold)):
+            from blades_tpu.parallel.streamed_geometry import WIRE_AGGREGATORS
+
+            codec = cfg.get_codec()
+            if (codec is not None and codec.supports_deferred
+                    and codec.name != "identity"
+                    and isinstance(self.fed_round.server.aggregator,
+                                   WIRE_AGGREGATORS)):
+                agg_domains.append("wire")
+
         return at.enumerate_plans(
             executions=execs, d_chunks=d_chunks, mxu_modes=mxu_modes,
             pack_factors=packs, scan_windows=windows,
-            prefetch_options=prefetch_options,
+            prefetch_options=prefetch_options, agg_domains=agg_domains,
             allow_reassociating=allow_reassociating,
         )
 
@@ -901,6 +926,21 @@ class Fedavg:
             # device program carries no extra outputs.
             row.update(codec.round_metrics(self.config.num_clients,
                                            self._num_params))
+            # Aggregation-domain provenance (wire-domain aggregation):
+            # which domain the defenses ran in and the storage width of
+            # the matrix they traversed (8 = packed int8 wire payload,
+            # 32 = dense f32), so A/B rows are separable in telemetry.
+            # Static per round, stamped host-side like the bytes above.
+            domain = getattr(self.fed_round, "agg_domain", "f32")
+            row["agg_domain"] = domain
+            row["agg_domain_bits"] = (codec.storage_bits
+                                      if domain == "wire" else 32)
+        if "dequant_rows" in metrics:
+            # Wire-domain decode accounting: full-width f32 rows
+            # materialized from the packed payload this round (selected
+            # slices + the forge's sanctioned full read) — the honesty
+            # counter next to the 1-byte hbm traversals.
+            row["dequant_rows"] = int(metrics["dequant_rows"])
         packing = getattr(self.fed_round, "packing", None)
         if packing is not None:
             # Lane-packing provenance (parallel/packed.py): static per

@@ -44,6 +44,20 @@ codec simulates the wire without changing storage dtypes anywhere.
 Byte accounting (``payload_bytes``) is reconciled against the analytic
 ICI model in :mod:`blades_tpu.parallel.comm_model` (``uplink_bytes``),
 so throughput projections cover compressed rounds.
+
+**Deferred decode (wire-domain aggregation).**  :meth:`CodecConfig.
+decode_deferred` is the alternative to ``encode_decode`` the
+``agg_domain="wire"`` round uses: instead of materializing the dense
+f32 matrix it returns the PACKED wire representation ``(q int8,
+row_scales f32)`` with ``dequantize(q, scales) == decode`` bit for bit
+(the stochastic-rounding draw is identical — one quantization source
+of truth).  The defense statistics then traverse the 1-byte integer
+matrix (:func:`blades_tpu.parallel.streamed_geometry.aggregate_wire`)
+and only O(n²)/O(n·R) outputs plus explicitly-selected row slices ever
+touch f32.  :func:`dequantize` is the raw decode-to-f32 primitive:
+calling it outside this module and the pass planner module is a
+``streamed-pass-discipline`` lint finding — a stray full-matrix decode
+silently reverts the wire domain's 4x HBM saving.
 """
 
 from __future__ import annotations
@@ -61,6 +75,22 @@ CODEC_NAMES = ("identity", "quant", "topk")
 # agg/dp) untouched, so a codec-free round is bit-identical to the
 # pre-comm program.
 CODEC_KEY_FOLD = 0xC0DE
+
+
+def dequantize(q: jax.Array, scales) -> jax.Array:
+    """Materialize the dense f32 matrix from a deferred wire payload:
+    ``q * scales`` row-wise (``scales is None`` — the identity codec's
+    f32 wire — passes ``q`` through untouched).
+
+    This is THE decode-to-f32 primitive of the wire domain, and a full
+    HBM materialization of the giant matrix.  Calling it outside this
+    module and :mod:`blades_tpu.parallel.streamed_geometry` (whose pass
+    planner dequantizes algebraically, per accumulated statistic) is a
+    ``streamed-pass-discipline`` lint finding.
+    """
+    if scales is None:
+        return q
+    return q.astype(jnp.float32) * scales[:, None]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -110,6 +140,25 @@ class CodecConfig:
     def topk_k(self, d: int) -> int:
         """Coordinates transmitted per client row (``topk``)."""
         return min(d, max(1, int(round(self.topk_ratio * d))))
+
+    @property
+    def supports_deferred(self) -> bool:
+        """Whether :meth:`decode_deferred` has a packed-integer (or
+        pass-through) wire representation: the quant grids and the
+        bit-transparent identity wire.  Top-k's wire is sparse f32
+        (value + index pairs) — there is no integer matrix for the
+        defense statistics to traverse, so it has no deferred mode."""
+        return self.name in ("identity", "quant")
+
+    @property
+    def storage_bits(self) -> int:
+        """Bits per element of the AGGREGATION-domain storage under
+        deferred decode (the ``agg_domain_bits`` metric): 8 for the
+        quant grids (int4 values ride int8 storage — the wire width in
+        :attr:`wire_bits` stays 4, but the resident matrix the defense
+        statistics traverse is one byte per coordinate), 32 for the
+        identity codec's f32 pass-through."""
+        return 8 if self.name == "quant" else 32
 
     @property
     def wire_bits(self) -> int:
@@ -183,13 +232,79 @@ class CodecConfig:
         ``x = u / scale`` lands in ``[-s, s]``; stochastic rounding
         takes ``floor(x) + Bernoulli(frac(x))``, whose expectation is
         ``x`` — so ``E[q * scale] = u`` exactly (the unbiasedness the
-        statistical test pins down)."""
+        statistical test pins down).  Implemented as deferred-encode +
+        :func:`dequantize` so the f32 and wire aggregation domains share
+        ONE quantization: the grid values are small integers, exactly
+        representable through the int8 round trip, so this factoring is
+        bit-identical to multiplying the un-packed grid by the scale."""
+        return dequantize(*self._quantize_deferred(u, key))
+
+    def _quantize_deferred(
+        self, u: jax.Array, key: jax.Array
+    ) -> Tuple[jax.Array, jax.Array]:
+        """``(q int8 (n, d), scales f32 (n,))`` with
+        ``dequantize(q, scales) == _quantize(u, key)`` bit for bit."""
         s = float(2 ** (self.bits - 1) - 1)
-        scale = jnp.max(jnp.abs(u), axis=1, keepdims=True) / s
-        x = u / jnp.where(scale > 0, scale, 1.0)
+        scale = jnp.max(jnp.abs(u), axis=1) / s
+        x = u / jnp.where(scale > 0, scale, 1.0)[:, None]
         lo = jnp.floor(x)
         q = lo + (jax.random.uniform(key, u.shape) < (x - lo))
-        return jnp.clip(q, -s, s) * scale
+        return jnp.clip(q, -s, s).astype(jnp.int8), scale
+
+    def decode_deferred(
+        self, updates: jax.Array, residual, key: jax.Array
+    ) -> Tuple[jax.Array, Optional[jax.Array], jax.Array]:
+        """The wire-domain round's ``encode_decode``: one round of the
+        simulated wire WITHOUT materializing dense f32 —
+        ``(q, row_scales, new_residual)``.
+
+        ``quant``: ``q`` is the packed int8 grid (int4 values ride int8
+        storage) and ``row_scales`` the per-row f32 scales;
+        ``dequantize(q, row_scales)`` equals what ``encode_decode``
+        would have returned bit for bit (same stochastic-rounding
+        draw).  ``identity``: the wire is f32 — ``q`` IS ``updates``
+        and ``row_scales`` is ``None``, so callers fall back to the f32
+        aggregation path unchanged.  Top-k raises
+        (:attr:`supports_deferred`).
+        """
+        if self.name == "identity":
+            return updates, None, residual
+        if self.name == "quant":
+            q, scales = self._quantize_deferred(updates, key)
+            return q, scales, residual
+        raise ValueError(
+            "decode_deferred: the top-k wire is sparse f32 (value+index "
+            "pairs) — no packed-integer matrix exists for wire-domain "
+            "aggregation; use encode_decode (agg_domain='f32')"
+        )
+
+    def requantize_rows(
+        self,
+        dec: jax.Array,
+        q: jax.Array,
+        scales: jax.Array,
+        rows: jax.Array,
+    ) -> Tuple[jax.Array, jax.Array]:
+        """Re-encode selected rows of a (partially rewritten) dense f32
+        matrix back onto the wire grid: rows where ``rows`` (``(n,)``
+        bool) is True get fresh ``(q, scale)`` payloads from ``dec``;
+        the rest keep their exact packed representation.
+
+        This is how forged malicious lanes re-enter the wire-domain
+        round: the adversary reads the quantized-domain geometry,
+        computes its attack rows in f32, and — like any client — its
+        payload rides the same int8 wire.  Deterministic
+        round-to-nearest (no dither): the adversary does not randomize
+        its own payload.
+        """
+        s = float(2 ** (self.bits - 1) - 1)
+        rescale = jnp.max(jnp.abs(dec), axis=1) / s
+        x = dec / jnp.where(rescale > 0, rescale, 1.0)[:, None]
+        rq = jnp.clip(jnp.round(x), -s, s).astype(jnp.int8)
+        return (
+            jnp.where(rows[:, None], rq, q),
+            jnp.where(rows, rescale, scales),
+        )
 
     def _topk(
         self, u: jax.Array, residual

@@ -138,6 +138,23 @@ class FedRound:
     # program literally unchanged; set via FedavgConfig.resources(
     # client_packing=...), whose "auto" mode gates eligibility loudly.
     packing: Any = None
+    # Aggregation domain under a codec (blades_tpu/comm): "f32" decodes
+    # the wire payload to the dense f32 matrix before the defenses (the
+    # bit-identical default — the pre-wire-domain program, literally);
+    # "wire" keeps quantized updates PACKED (int8 + per-row scales)
+    # through the defense statistics via Server.step_wire — the fused
+    # traversals read one byte per coordinate, per-row scales apply
+    # algebraically to the accumulated statistics, and the adversary
+    # still forges post-codec: it reads the quantized-domain geometry
+    # and its forged rows re-enter the same int8 wire
+    # (CodecConfig.requantize_rows).  Config-time validation restricts
+    # "wire" to dense single-chip rounds with a deferrable codec and
+    # none of the f32-domain-only features (faults/health/forensics/DP).
+    agg_domain: str = "f32"
+    # Chunk width of the wire-domain statistics traversals (the streamed
+    # d_chunk knob applied to the dense wire path; kernel-eligible
+    # shapes take the fused pallas stripe kernel instead).
+    agg_d_chunk: int = 1 << 17
 
     # -- construction -------------------------------------------------------
 
@@ -298,9 +315,28 @@ class FedRound:
         if self.codec is not None:
             from blades_tpu.comm.codecs import CODEC_KEY_FOLD
 
-            updates, residual = self.codec.encode_decode(
-                updates, residual, jax.random.fold_in(key, CODEC_KEY_FOLD)
-            )
+            codec_key = jax.random.fold_in(key, CODEC_KEY_FOLD)
+            if self.agg_domain == "wire":
+                # Wire-domain aggregation: the payload stays PACKED
+                # (q int8, per-row scales) through forging and the
+                # defense statistics — the dense f32 matrix is never
+                # rebuilt.  Identity codec: the wire IS f32 (scales is
+                # None), so the round falls through to the standard
+                # path below, bit-identical to agg_domain="f32".
+                q, wire_scales, residual = self.codec.decode_deferred(
+                    updates, residual, codec_key
+                )
+                if wire_scales is None:
+                    updates = q
+                else:
+                    return self._finish_wire(
+                        state, q, wire_scales, residual, client_opt,
+                        losses, malicious, k_adv, k_agg,
+                    )
+            else:
+                updates, residual = self.codec.encode_decode(
+                    updates, residual, codec_key
+                )
         # Chaos layer (blades_tpu/faults): dropout / stragglers / lane
         # corruption, realized deterministically from (fault seed, round).
         # Runs at the point the updates "arrive at the server" — before
@@ -400,6 +436,82 @@ class FedRound:
             metrics["lane_healthy"] = healthy_mask.astype(jnp.float32)
         return RoundState(server=server, client_opt=client_opt, stale=stale,
                           residual=residual), metrics
+
+    def _finish_wire(
+        self,
+        state: RoundState,
+        q: jax.Array,
+        scales: jax.Array,
+        residual,
+        client_opt,
+        losses: jax.Array,
+        malicious: jax.Array,
+        k_adv: jax.Array,
+        k_agg: jax.Array,
+    ) -> Tuple[RoundState, dict]:
+        """The wire-domain tail of :meth:`step_prebatched`: forge, robust
+        aggregate and server step over the PACKED payload ``(q int8,
+        scales)`` — the dense f32 matrix is materialized exactly once,
+        and only when an update-forging adversary needs the full
+        quantized-domain geometry (counted in ``dequant_rows``).
+
+        The adversary contract matches the f32 domain — it forges
+        POST-codec, reading the same quantized geometry every defense
+        will see — with one wire-honest difference: its forged rows ride
+        the same int8 wire as every client's
+        (:meth:`~blades_tpu.comm.codecs.CodecConfig.requantize_rows`,
+        deterministic round-to-nearest), where the f32 domain hands the
+        defense full-precision forged rows that never passed the wire.
+        Validation (config.py) keeps faults/health/forensics/DP off this
+        path; metrics carry the same scalar keys plus the planner's
+        traversal accounting (``hbm_passes``/``hbm_passes_unfused``/
+        ``dequant_rows``, trace-time constants like the streamed path's).
+        """
+        from blades_tpu.parallel.streamed_geometry import PassRecorder
+
+        dequant_extra = 0
+        if self.adversary is not None and hasattr(
+            self.adversary, "on_updates_ready"
+        ):
+            from blades_tpu.comm.codecs import dequantize
+
+            dec = dequantize(q, scales)  # blades-lint: disable=streamed-pass-discipline — sanctioned forge materialization: the adversary reads the FULL quantized-domain geometry (strongest-adversary convention); the single decode is counted in dequant_rows
+            dec = self.adversary.on_updates_ready(
+                dec, malicious, k_adv,
+                aggregator=self.server.aggregator,
+                global_params=state.server.params,
+            )
+            q, scales = self.codec.requantize_rows(dec, q, scales, malicious)
+            dequant_extra = q.shape[0]
+        trusted_update = self.compute_trusted_update(
+            state.server.params, jax.random.fold_in(k_agg, 1)
+        )
+        recorder = PassRecorder()
+        server, agg, sq = self.server.step_wire(
+            state.server, q, scales, key=k_agg,
+            trusted_update=trusted_update, d_chunk=self.agg_d_chunk,
+            recorder=recorder,
+        )
+        benign = (~malicious).astype(jnp.float32)
+        train_loss = (losses * benign).sum() / jnp.maximum(benign.sum(), 1.0)
+        metrics = {
+            "train_loss": train_loss,
+            # Decoded-row norms from the statistics bundle (s_i²·Σq_ij²),
+            # not a dedicated f32 traversal; differs from the f32 path's
+            # jnp.linalg.norm by reassociated rounding only.
+            "update_norm_mean": jnp.sqrt(jnp.maximum(sq, 0.0)).mean(),
+            "agg_norm": jnp.linalg.norm(agg),
+            "round": server.round,
+            # Planner traversal accounting, frozen at trace time exactly
+            # like the streamed path's hbm stamps.
+            "hbm_passes": jnp.int32(recorder.executed),
+            "hbm_passes_unfused": jnp.int32(recorder.unfused),
+            "dequant_rows": jnp.int32(recorder.dequant_rows + dequant_extra),
+        }
+        return RoundState(
+            server=server, client_opt=client_opt,
+            stale=getattr(state, "stale", None), residual=residual,
+        ), metrics
 
     def multi_step(
         self,

@@ -164,6 +164,44 @@ class Server:
             )
         return self.apply_aggregate(state, agg, agg_state), agg
 
+    def step_wire(
+        self,
+        state: ServerState,
+        q: jax.Array,
+        scales: Optional[jax.Array],
+        *,
+        key: Optional[jax.Array] = None,
+        trusted_update: Optional[jax.Array] = None,
+        d_chunk: int = 1 << 17,
+        recorder=None,
+        use_kernel=None,
+        interpret: bool = False,
+    ) -> Tuple[ServerState, jax.Array, jax.Array]:
+        """:meth:`step` for a deferred-decode wire payload
+        (``agg_domain="wire"``): the robust aggregate is computed from
+        the packed int8 matrix ``q`` and per-row ``scales`` by
+        :func:`blades_tpu.parallel.streamed_geometry.aggregate_wire` —
+        the dense f32 matrix is never materialized; statistics apply the
+        wire scales algebraically and only selected/reduced slices
+        decode.  Returns ``(new_state, aggregate, sq)`` where ``sq`` is
+        the decoded rows' squared norms (free inside the first
+        statistics bundle — the round's ``update_norm_mean`` basis).
+
+        FLTrust's root-of-trust contract holds: ``aggregate_wire``
+        refuses to run it without ``trusted_update`` (the trusted row is
+        threaded separately instead of appended — it never rides the
+        wire).  No ``participation`` parameter: the chaos layer is
+        f32-domain only (validated at config time).
+        """
+        from blades_tpu.parallel.streamed_geometry import aggregate_wire
+
+        agg, agg_state, sq = aggregate_wire(
+            self.aggregator, q, scales, state=state.agg_state, key=key,
+            trusted=trusted_update, d_chunk=d_chunk, recorder=recorder,
+            use_kernel=use_kernel, interpret=interpret,
+        )
+        return self.apply_aggregate(state, agg, agg_state), agg, sq
+
     def step_diag(
         self,
         state: ServerState,

@@ -69,6 +69,19 @@ ROUND_RECORD_FIELDS: Dict[str, Tuple[tuple, bool]] = {
     "comm_bytes_up": ((int,), False),
     "codec_bits": ((int,), False),
     "comm_compression_ratio": (_NUM, False),
+    # Wire-domain aggregation (agg_domain="wire"): which domain the
+    # defense statistics ran in ("f32" | "wire"), the storage width of
+    # the matrix they traversed (32 = dense f32; 8 = packed int8 wire
+    # payload — int4 codec values ride int8 storage, so their wire width
+    # lives in codec_bits while agg_domain_bits stays 8), and the
+    # decode honesty counter: full-width f32 rows materialized from the
+    # packed payload this round (selected/reduced slices + the forge's
+    # sanctioned full read).  Stamped host-side whenever a codec is
+    # configured (agg_domain/agg_domain_bits) / whenever the wire round
+    # ran (dequant_rows).
+    "agg_domain": ((str,), False),
+    "agg_domain_bits": ((int,), False),
+    "dequant_rows": ((int,), False),
     # Client lane-packing (parallel/packed.py): static per-round
     # provenance stamped host-side when the dense round runs P clients
     # per grouped-kernel vmap lane.  pack_factor = clients per lane,

@@ -26,6 +26,20 @@ both from one shared selection — so enabling diagnostics cannot change
 numerics, and when the diag outputs are unused XLA dead-code-eliminates
 them (zero overhead when disabled).
 
+**Wire-domain aggregation** (comm subsystem, :mod:`blades_tpu.comm`):
+every aggregator here also runs over a PACKED quantized payload —
+``(q int8, row_scales f32)`` from ``CodecConfig.decode_deferred`` —
+via :func:`blades_tpu.parallel.streamed_geometry.aggregate_wire`
+(``agg_domain="wire"``): the seven row-geometry defenses reuse their
+streamed request/plan/execute formulations over a ``row_scale`` pass
+planner (scales applied algebraically to the accumulated statistics),
+``Mean`` is a folded weighted row sum, and ``Median``/``Trimmedmean``
+rank per-chunk decoded values — EXACTLY the values the dense paths
+below would rank, so the coordinate-wise pair is equivalence-exact
+while the rest carry the documented f32-reassociation tolerance.  The
+dense implementations in this module remain the reference semantics
+the wire formulations are tested against.
+
 **Partial participation** (chaos layer, :mod:`blades_tpu.faults`): every
 aggregator also exposes ``masked_call``/``masked_diagnose`` taking an
 ``(n,)`` participation mask.  A full-participation mask dispatches (via

@@ -29,6 +29,21 @@ contractions accumulate in f32).  Equivalence is tested in interpret
 mode against the chunk path per the ``test_pallas_*`` convention
 (tests/test_pass_fusion.py).
 
+**Integer input (wire-domain aggregation).**  The bundle also accepts a
+packed int8 matrix (the deferred-decode wire payload of
+:mod:`blades_tpu.comm.codecs`): each stripe then loads ONE byte per
+coordinate from HBM — a 4x traffic cut against the f32 matrix the
+f32-domain path traverses — and the self-contractions ride the MXU's
+int8 path: Gram stripes and row squared norms accumulate int8*int8 ->
+int32 EXACTLY (|q| <= 127 over a 512-wide stripe is ~8.3e6 << 2^31)
+before joining the cross-stripe f32 accumulator, and the sign counts
+read comparisons straight off the integers.  Mixed contractions (dots
+against replicated f32 vectors, f32 row weights) cast the resident
+stripe to f32 in VMEM — the HBM read is still one byte.  Per-row scale
+algebra (``s_i s_j`` on the Gram, ``s_i²`` on the norms, weight folding)
+is the CALLER's job (the pass planner applies it to the accumulated
+statistics); this kernel computes raw integer geometry.
+
 Gated by the same envelope as :func:`blades_tpu.ops.pallas_select.
 kernel_applicable` plus a no-copy row alignment requirement and a
 tighter height bound when the Gram accumulator is requested (the
@@ -55,17 +70,25 @@ from blades_tpu.ops.pallas_select import kernel_applicable as _select_gate
 _GRAM_MAX_N = 1024
 
 
-def kernel_applicable(n: int, d: int, *, gram: bool = False) -> bool:
+def kernel_applicable(n: int, d: int, *, gram: bool = False,
+                      elem_bits: int = 32,
+                      integer: bool = False) -> bool:
     """Can the fused row-stats kernel serve an ``(n, d)`` bundle?
 
     The shared rank-select envelope (TPU backend, VMEM height bound,
-    size floor, ``BLADES_TPU_NO_PALLAS`` escape hatch) plus ``n % 8 == 0``
-    — row padding here would copy the giant matrix — and the tighter
-    Gram height bound when the bundle carries a Gram request.
+    size floor, ``BLADES_TPU_NO_PALLAS`` escape hatch) plus a no-copy
+    row alignment requirement — ``n % 8 == 0`` for float stripes,
+    ``n % 32 == 0`` for int8 ones (the int8 native tile is 32 sublanes;
+    padding here would copy the giant matrix) — and the tighter Gram
+    height bound when the bundle carries a Gram request.  ``elem_bits``
+    names the element width of the stored matrix (int8 stripes read a
+    quarter of the f32 bytes, so a smaller width only relaxes the VMEM
+    envelope — the f32 gate stays the conservative bound).
     """
+    del elem_bits  # narrower elements only shrink the stripe footprint
     if not _select_gate(n, d):
         return False
-    if n % 8:
+    if n % (32 if integer else 8):
         return False
     if gram and n > _GRAM_MAX_N:
         return False
@@ -87,7 +110,9 @@ def _rowstats_kernel(*refs, want_sq: bool, want_gram: bool, want_signs: bool,
     gd_ref = next(it) if n_gd else None
 
     i = pl.program_id(0)
-    x = x_ref[...].astype(jnp.float32)  # (npad, block_d) stripe
+    raw = x_ref[...]                     # (npad, block_d) stripe
+    integer = jnp.issubdtype(raw.dtype, jnp.integer)
+    x = raw.astype(jnp.float32)
 
     @pl.when(i == 0)
     def _init():
@@ -96,14 +121,28 @@ def _rowstats_kernel(*refs, want_sq: bool, want_gram: bool, want_signs: bool,
                 ref[...] = jnp.zeros_like(ref)
 
     if sq_ref is not None:
-        sq_ref[...] += jnp.sum(x * x, axis=1, keepdims=True)
+        if integer:
+            # int8 stripes: exact int32 per-stripe sums (|q| <= 127 over
+            # a 512-wide stripe is far below 2^31), f32 across stripes.
+            xi = raw.astype(jnp.int32)
+            sq_ref[...] += jnp.sum(xi * xi, axis=1,
+                                   keepdims=True).astype(jnp.float32)
+        else:
+            sq_ref[...] += jnp.sum(x * x, axis=1, keepdims=True)
     if gram_ref is not None:
-        gram_ref[...] += jax.lax.dot_general(
-            x, x, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        if integer:
+            # The MXU's native int8 path: int8 x int8 -> int32 stripe
+            # contraction, EXACT, cast once into the f32 accumulator.
+            gram_ref[...] += jax.lax.dot_general(
+                raw, raw, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.int32).astype(jnp.float32)
+        else:
+            gram_ref[...] += jax.lax.dot_general(
+                x, x, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
     if signs_ref is not None:
-        pos = jnp.sum((x > 0).astype(jnp.float32), axis=1, keepdims=True)
-        neg = jnp.sum((x < 0).astype(jnp.float32), axis=1, keepdims=True)
+        pos = jnp.sum((raw > 0).astype(jnp.float32), axis=1, keepdims=True)
+        neg = jnp.sum((raw < 0).astype(jnp.float32), axis=1, keepdims=True)
         signs_ref[...] += jnp.concatenate([pos, neg], axis=1)
     if dots_ref is not None:
         v = dv_ref[...]  # (R, block_d) stripe of the replicated vectors
@@ -141,8 +180,10 @@ def row_stats_bundle(
 
     Args:
         buf: ``(n, d_alloc)`` matrix, any float dtype (bf16 reads at half
-            bandwidth; compute is f32).  Columns past ``d_true`` must be
-            zero (stripe-alignment padding).
+            bandwidth; compute is f32) or int8 (the deferred-decode wire
+            payload: one-byte stripes, int8 MXU self-contractions; the
+            caller owns the per-row scale algebra).  Columns past
+            ``d_true`` must be zero (stripe-alignment padding).
         sq/gram/signs: request the respective accumulator.
         dots: ``(R, d_true)`` replicated vectors to dot every row against.
         weights: ``(W, n)`` row-weight vectors for weighted row sums.
@@ -168,7 +209,10 @@ def row_stats_bundle(
         raise ValueError("empty row-stats bundle")
 
     x = buf
-    npad = -(-n // 8) * 8
+    # int8 tiles are 32 sublanes tall (f32/bf16: 8); at giant scale the
+    # gate (kernel_applicable integer=) makes this pad a no-op.
+    sub = 32 if jnp.issubdtype(buf.dtype, jnp.integer) else 8
+    npad = -(-n // sub) * sub
     if npad != n:
         x = jnp.concatenate(
             [x, jnp.zeros((npad - n, d_alloc), x.dtype)], axis=0)

@@ -51,6 +51,33 @@ inside the passes.  Each implementation mirrors the dense one in
 :mod:`blades_tpu.ops.aggregators` — same constants, same selection
 logic, same empty-mask degradation.
 
+**Wire-domain aggregation** (``row_scale=``): the planner also serves
+the deferred-decode payload of :mod:`blades_tpu.comm.codecs` — a packed
+int8 matrix ``q`` plus per-row f32 scales ``s`` whose logical matrix is
+``diag(s) @ q``.  The buffer is NEVER dequantized wholesale; instead
+each accumulator applies the scale ALGEBRAICALLY, at the statistic's
+own (tiny) output shape:
+
+- ``sq_i -> s_i² · Σ q_ij²`` and ``G_ij -> s_i s_j · (q_i · q_j)``
+  (norms/Gram scale as ``s_i s_j``);
+- ``dots(v) -> s · (q @ v)`` and ``gram_dot(w) -> s · (q qᵀ (s·w))``;
+- ``weighted_sum(w) -> (w·s) @ q`` (weights fold, the output is the
+  already-decoded ``(d,)`` row);
+- sign counts read comparisons straight off the integers (``s_i >= 0``
+  never flips a sign; an all-zero row has ``s_i = 0`` AND ``q_i = 0``);
+- chunk-only requests (``gather``, ``mean_std``, ``masked_median``,
+  ``coordwise``) dequantize exactly the slice in flight — the only
+  places f32 rows materialize, counted as ``dequant_rows``.
+
+So the traversals read ONE byte per coordinate (the int8 kernel variant
+in :mod:`blades_tpu.ops.pallas_rowstats` keeps the Gram/norms on the
+MXU's exact int8 path) and only O(n²)/O(n·R) outputs plus explicitly
+selected slices ever touch f32.  :func:`aggregate_wire` is the
+dispatch; equivalence against decode-then-f32 carries the same
+f32-reassociation tolerances as the fused chunk path (the quantized
+grid values are exactly representable, so the scale algebra itself adds
+no error beyond reassociated rounding).
+
 Chunks follow the streamed finish's scheme: fixed width ``c``, starts
 ``min(i*c, d - c)`` (the tail chunk overlaps; accumulating passes mask
 already-covered columns via :func:`new_cols`, idempotent writes just
@@ -79,14 +106,25 @@ from blades_tpu.ops.aggregators import (
     Clippedclustering,
     FLTrust,
     GeoMed,
+    Mean,
+    Median,
     Multikrum,
     Signguard,
+    Trimmedmean,
 )
 
 STREAMED_ROW_AGGREGATORS = (
     GeoMed, DnC, Multikrum, Centeredclipping, Signguard, Clippedclustering,
     FLTrust,
 )
+
+# Everything aggregate_wire can serve from a deferred-decode payload:
+# the row-geometry implementations below (scale algebra on the fused
+# statistics) plus the coordinate-wise trio (Mean as a folded weighted
+# sum; Median/Trimmedmean decode each in-flight chunk for their order
+# statistics — exactly the values decode-then-f32 would rank, so those
+# two are EXACT, not tolerance-bound).
+WIRE_AGGREGATORS = STREAMED_ROW_AGGREGATORS + (Mean, Median, Trimmedmean)
 
 
 def streamed_row_forgers():
@@ -165,12 +203,21 @@ class PassRecorder:
     def __init__(self):
         self.executed = 0
         self.unfused = 0
+        # Full-width f32 row equivalents materialized from a quantized
+        # buffer (wire-domain planners only): the ``dequant_rows``
+        # metric.  Statistics served by scale algebra count zero; each
+        # chunk-only request that decodes row data counts its output
+        # rows (weighted sums/medians/coordwise: 1, mean+std: 2,
+        # gathers: their column fraction of the width, rounded up).
+        self.dequant_rows = 0
         self._final = False
 
-    def count(self, executed: int, unfused: int, mult: int = 1) -> None:
+    def count(self, executed: int, unfused: int, mult: int = 1,
+              dequant: int = 0) -> None:
         if not self._final:
             self.executed += executed * mult
             self.unfused += unfused * mult
+            self.dequant_rows += dequant * mult
 
     def finalize(self) -> None:
         self._final = True
@@ -218,11 +265,17 @@ class PassPlanner:
             ``True`` forces the kernel (tests drive it in interpret
             mode); ``False`` forces the chunk loop.
         interpret: run the kernel in pallas interpret mode (tests).
+        row_scale: ``(n,)`` f32 per-row scales of a deferred-decode wire
+            payload — the planner's LOGICAL matrix is then
+            ``row_scale[:, None] * buf`` (``buf`` typically int8), with
+            every accumulator applying the scale algebraically (module
+            docstring).  ``None`` = the stored matrix is the logical one.
     """
 
     def __init__(self, buf: jax.Array, c: int, *, d: Optional[int] = None,
                  recorder: Optional[PassRecorder] = None, fuse: bool = True,
-                 use_kernel: Optional[bool] = None, interpret: bool = False):
+                 use_kernel: Optional[bool] = None, interpret: bool = False,
+                 row_scale: Optional[jax.Array] = None):
         self.buf = buf
         self.n = buf.shape[0]
         self.d = int(d) if d is not None else buf.shape[1]
@@ -231,6 +284,7 @@ class PassPlanner:
         self.fuse = fuse
         self.use_kernel = use_kernel
         self.interpret = interpret
+        self.row_scale = row_scale
         self._pending: List[_Req] = []
         self._mult = 1
 
@@ -260,7 +314,11 @@ class PassPlanner:
 
     def weighted_sum(self, w: jax.Array) -> PassHandle:
         """``w @ buf`` ``(d,)`` — weighted row sum (w includes any row
-        scale).  Overwrite-idempotent on the overlap tail."""
+        scale).  Overwrite-idempotent on the overlap tail.  Under
+        ``row_scale`` the wire scales fold into ``w`` here — the output
+        IS the decoded row, so no post-scaling exists for it."""
+        if self.row_scale is not None:
+            w = w * self.row_scale
         return self._req("wsum", w=w)
 
     def gram_dot(self, w: jax.Array) -> PassHandle:
@@ -268,6 +326,10 @@ class PassPlanner:
         per chunk ``C_new @ (C.T @ w)``.  The fusion lever for iterative
         centers: ``buf @ wavg(w) = gram_dot(w) / w.sum()``, so the pass
         producing iterate k's center also yields every distance to it."""
+        if self.row_scale is not None:
+            # (S q qᵀ S) w: fold one S into the weights here, the
+            # execute-time post-scale applies the other to the output.
+            w = w * self.row_scale
         return self._req("gram_dot", w=w)
 
     def gather(self, idx: jax.Array) -> PassHandle:
@@ -283,6 +345,8 @@ class PassPlanner:
     def masked_median(self, mask: jax.Array, row_scale: jax.Array) -> PassHandle:
         """Coordinate-wise median over selected rows of
         ``buf * row_scale`` ``(d,)`` (chunk path only)."""
+        if self.row_scale is not None:
+            row_scale = row_scale * self.row_scale
         return self._req("masked_median", mask=mask, row_scale=row_scale)
 
     def coordwise(self, agg) -> PassHandle:
@@ -317,8 +381,43 @@ class PassPlanner:
                 self._run_kernel(group)
             else:
                 self._run_chunked(group)
+        if self.row_scale is not None:
+            self._apply_row_scale(reqs)
         if self.recorder is not None:
-            self.recorder.count(len(groups), len(reqs), self._mult)
+            dequant = (sum(self._dequant_rows(r) for r in reqs)
+                       if self.row_scale is not None else 0)
+            self.recorder.count(len(groups), len(reqs), self._mult,
+                                dequant=dequant)
+
+    def _apply_row_scale(self, reqs) -> None:
+        """Scale algebra on the ACCUMULATED statistics (module
+        docstring): the raw integer passes above never saw the wire
+        scales, so the post-multiplications here decode each output at
+        its own O(n)/O(n²) shape.  Fold-in kinds (wsum/gram_dot's
+        weights, masked_median's row scale) already carried their S at
+        request time; chunk-only row materializers (mean_std, coordwise)
+        scaled each in-flight slice inside :meth:`_update`."""
+        s = self.row_scale
+        for r in reqs:
+            if r.kind == "sq":
+                r.handle.value = r.handle.value * (s * s)
+            elif r.kind == "gram":
+                r.handle.value = r.handle.value * (s[:, None] * s[None, :])
+            elif r.kind in ("dots", "gram_dot"):
+                r.handle.value = r.handle.value * s
+            elif r.kind == "gather":
+                r.handle.value = r.handle.value * s[:, None]
+
+    def _dequant_rows(self, r: _Req) -> int:
+        """Full-width f32 row equivalents this request materializes from
+        the quantized buffer (the ``dequant_rows`` metric)."""
+        if r.kind in ("wsum", "masked_median", "coordwise"):
+            return 1
+        if r.kind == "mean_std":
+            return 2
+        if r.kind == "gather":
+            return -(-self.n * int(r.kw["idx"].shape[0]) // self.d)
+        return 0
 
     def _kernel_ok(self, reqs) -> bool:
         if self.use_kernel is False:
@@ -331,7 +430,9 @@ class PassPlanner:
         from blades_tpu.ops import pallas_rowstats
 
         return pallas_rowstats.kernel_applicable(
-            self.n, self.d, gram="gram" in kinds)
+            self.n, self.d, gram="gram" in kinds,
+            elem_bits=self.buf.dtype.itemsize * 8,
+            integer=bool(jnp.issubdtype(self.buf.dtype, jnp.integer)))
 
     def _run_kernel(self, reqs) -> None:
         from blades_tpu.ops import pallas_rowstats
@@ -440,6 +541,11 @@ class PassPlanner:
             return jnp.where(inside[None, :], vals, acc)
         if kind == "mean_std":
             # Same formulas as adversaries.base.benign_mean_std (ddof=1).
+            # Chunk-only request: under row_scale the in-flight slice is
+            # dequantized here (counted in dequant_rows) — per-coordinate
+            # moments have no whole-pass scale identity to exploit.
+            if self.row_scale is not None:
+                chunk = chunk * self.row_scale[:, None]
             mean_acc, std_acc = acc
             w = jnp.where(r.kw["malicious"], 0.0, 1.0).astype(jnp.float32)
             nb = jnp.maximum(w.sum(), 1.0)
@@ -455,6 +561,10 @@ class PassPlanner:
                 chunk * r.kw["row_scale"][:, None], r.kw["mask"])
             return lax.dynamic_update_slice(acc, med, (start,))
         if kind == "coordwise":
+            # Chunk-only: order statistics need the decoded values — the
+            # in-flight slice dequantizes under row_scale (dequant_rows).
+            if self.row_scale is not None:
+                chunk = chunk * self.row_scale[:, None]
             return lax.dynamic_update_slice(
                 acc, r.kw["agg"].aggregate(chunk), (start,))
         raise ValueError(f"unknown request kind {kind!r}")
@@ -984,3 +1094,79 @@ def aggregate_streamed(
         out, sq = _fltrust(agg, pl_, sq, trusted)
         return out, state, sq
     raise NotImplementedError(f"no streamed formulation for {type(agg).__name__}")
+
+
+def aggregate_wire(
+    agg,
+    q: jax.Array,
+    scales: Optional[jax.Array],
+    *,
+    state: Any = (),
+    key: Optional[jax.Array] = None,
+    trusted: Optional[jax.Array] = None,
+    d_chunk: int = 1 << 17,
+    d: Optional[int] = None,
+    recorder: Optional[PassRecorder] = None,
+    fuse: bool = True,
+    use_kernel: Optional[bool] = None,
+    interpret: bool = False,
+) -> Tuple[jax.Array, Any, jax.Array]:
+    """Aggregate a deferred-decode wire payload WITHOUT materializing the
+    dense f32 matrix (the ``agg_domain="wire"`` round's defense stage).
+
+    Args:
+        agg: an instance of one of :data:`WIRE_AGGREGATORS`.
+        q: ``(n, d)`` packed wire matrix — int8 under the quant codecs
+            (int4 values ride int8 storage), f32 when ``scales`` is
+            ``None`` (the identity wire; the planner then runs exactly
+            the unscaled statistics).
+        scales: ``(n,)`` f32 per-row wire scales, or ``None``.
+        state/key/trusted: as :func:`aggregate_streamed`.
+        d_chunk/d/recorder/fuse/use_kernel/interpret: see
+            :class:`PassPlanner`.
+
+    Returns ``(aggregate (d,) f32, new_state, sq (n,) f32)`` where
+    ``sq`` holds the squared norms of the DECODED rows (``s_i²·Σq_ij²``
+    — the round's ``update_norm_mean`` basis, free inside the first
+    statistics bundle).
+
+    Equivalence vs decode-then-f32: the row-geometry implementations
+    carry the documented f32-reassociation tolerances of the fused
+    chunk path; Median/Trimmedmean rank the identical decoded values
+    chunk by chunk and are exact; Mean reassociates one weighted sum.
+    """
+    pl_ = PassPlanner(q, d_chunk, d=d, recorder=recorder, fuse=fuse,
+                      use_kernel=use_kernel, interpret=interpret,
+                      row_scale=scales)
+    if isinstance(agg, (Mean, Median, Trimmedmean)):
+        n = pl_.n
+        h_sq = pl_.sq_norms()
+        if isinstance(agg, Mean):
+            h_out = pl_.weighted_sum(jnp.full((n,), 1.0 / n, jnp.float32))
+        else:
+            h_out = pl_.coordwise(agg)
+        pl_.execute()  # norms + the coordinate-wise finish: ONE traversal
+        return h_out.value, state, h_sq.value
+    if isinstance(agg, GeoMed):
+        out, sq = _geomed(agg, pl_, None)
+        return out, state, sq
+    if isinstance(agg, Multikrum):
+        out, sq = _multikrum(agg, pl_, None)
+        return out, state, sq
+    if isinstance(agg, DnC):
+        out, sq = _dnc(agg, pl_, None, key)
+        return out, state, sq
+    if isinstance(agg, Centeredclipping):
+        out, new_state, sq = _centeredclipping(agg, pl_, None, state)
+        return out, new_state, sq
+    if isinstance(agg, Signguard):
+        out, sq = _signguard(agg, pl_, None)
+        return out, state, sq
+    if isinstance(agg, Clippedclustering):
+        out, new_state, sq = _clippedclustering(agg, pl_, None, state)
+        return out, new_state, sq
+    if isinstance(agg, FLTrust):
+        out, sq = _fltrust(agg, pl_, None, trusted)
+        return out, state, sq
+    raise NotImplementedError(
+        f"no wire-domain formulation for {type(agg).__name__}")

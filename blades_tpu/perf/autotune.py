@@ -94,12 +94,16 @@ class Plan:
     mxu_finish: str = ""              # "" | "counts" | "all" (streamed)
     rounds_per_dispatch: int = 1      # chained scan window; 1 = per-round
     prefetch: bool = False            # dense single-round batch staging
+    agg_domain: str = "f32"           # "f32" | "wire" (dense + quant codec)
     tier: str = DEFAULT_TIER          # numerics tier this plan belongs to
 
     def __post_init__(self):
         if self.execution not in ("dense", "streamed"):
             raise ValueError(f"plan execution must be dense|streamed, "
                              f"got {self.execution!r}")
+        if self.agg_domain not in ("f32", "wire"):
+            raise ValueError(f"plan agg_domain must be f32|wire, "
+                             f"got {self.agg_domain!r}")
         if self.mxu_finish not in ("", "counts", "all"):
             raise ValueError(f"plan mxu_finish must be ''|'counts'|'all', "
                              f"got {self.mxu_finish!r}")
@@ -117,12 +121,15 @@ class Plan:
 
     @property
     def plan_id(self) -> str:
-        """Compact stable identifier, stamped per round (``plan_id``)."""
+        """Compact stable identifier, stamped per round (``plan_id``).
+        The wire-domain marker is appended only when engaged, so every
+        f32-domain id is byte-identical to the pre-knob format."""
         return (f"{self.execution}|c{int(self.d_chunk)}"
                 f"|p{int(self.client_packing)}"
                 f"|mxu={self.mxu_finish or 'off'}"
                 f"|w{int(self.rounds_per_dispatch)}"
-                f"|{'pre' if self.prefetch else 'nopre'}")
+                f"|{'pre' if self.prefetch else 'nopre'}"
+                + ("|wire" if self.agg_domain == "wire" else ""))
 
     def as_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -156,6 +163,10 @@ def apply_plan(config, plan: Plan) -> None:
                                  if plan.client_packing >= 2 else "off")
         if plan.rounds_per_dispatch == 1:
             config.prefetch = bool(plan.prefetch)
+        # Wire-domain aggregation (dense + deferrable codec only; the
+        # plan space never offers "wire" elsewhere, and an explicit
+        # user agg_domain pins its list to one entry).
+        config.agg_domain = plan.agg_domain
     else:
         config.client_packing = "off"
         config.mxu_finish = plan.mxu_finish
@@ -203,6 +214,7 @@ def enumerate_plans(
     pack_factors: Sequence[int] = (1,),
     scan_windows: Sequence[int] = (1,),
     prefetch_options: Sequence[bool] = (False,),
+    agg_domains: Sequence[str] = ("f32",),
     allow_reassociating: bool = False,
     max_candidates: int = MAX_CANDIDATES,
 ) -> PlanSpace:
@@ -213,13 +225,16 @@ def enumerate_plans(
     a list to one entry) — so the nested enumeration yields the current
     heuristic resolution as ``candidates[0]`` by construction.
 
-    Tier assignment: switching the execution path, packing clients, or
-    enabling the ``stats_mxu`` finish ("all") reassociates float
-    reductions and lands in :data:`REASSOCIATING_TIER`; chunk sizes,
-    the bit-exact radix counts ("counts"), chained scan windows and
-    prefetch stay :data:`DEFAULT_TIER`.  Without
-    ``allow_reassociating`` the reassociating tier is not enumerated at
-    all — an un-opted run can never be handed one.
+    Tier assignment: switching the execution path, packing clients,
+    aggregating in the quantized wire domain, or enabling the
+    ``stats_mxu`` finish ("all") reassociates float reductions and
+    lands in :data:`REASSOCIATING_TIER`; chunk sizes, the bit-exact
+    radix counts ("counts"), chained scan windows and prefetch stay
+    :data:`DEFAULT_TIER`.  Without ``allow_reassociating`` the
+    reassociating tier is not enumerated at all — an un-opted run can
+    never be handed one.  ``agg_domains`` applies to the dense path
+    only (codecs are dense-path features; the caller gates "wire" on a
+    deferrable quant codec and the absence of f32-domain-only stages).
     """
     if not executions:
         raise ValueError("executions must name at least the baseline path")
@@ -242,16 +257,23 @@ def enumerate_plans(
                             tier=tier))
             else:
                 for p in pack_factors:
-                    tier = exe_tier
-                    if p != pack_factors[0]:
-                        tier = REASSOCIATING_TIER
-                    pres = prefetch_options if int(w) == 1 else (False,)
-                    for pre in pres:
-                        plans.append(Plan(
-                            execution="dense", d_chunk=int(d_chunks[0]),
-                            client_packing=int(p), mxu_finish="",
-                            rounds_per_dispatch=int(w), prefetch=bool(pre),
-                            tier=tier))
+                    for ad in agg_domains:
+                        tier = exe_tier
+                        if p != pack_factors[0]:
+                            tier = REASSOCIATING_TIER
+                        if ad != agg_domains[0]:
+                            # Quantized-domain statistics reassociate f32
+                            # reductions AND rank on the int8 grid — never
+                            # a default-tier handout.
+                            tier = REASSOCIATING_TIER
+                        pres = prefetch_options if int(w) == 1 else (False,)
+                        for pre in pres:
+                            plans.append(Plan(
+                                execution="dense", d_chunk=int(d_chunks[0]),
+                                client_packing=int(p), mxu_finish="",
+                                rounds_per_dispatch=int(w),
+                                prefetch=bool(pre), agg_domain=str(ad),
+                                tier=tier))
     if not allow_reassociating:
         plans = [p for p in plans if p.tier == DEFAULT_TIER]
     # Dedupe preserving order (e.g. a chunk ladder whose entries clamp

@@ -231,6 +231,13 @@ def run_lanes(
 
         d_model = tree_size(states.server.params) // L  # per-lane width
         comm_row = fr.codec.round_metrics(base.num_clients, d_model)
+        # Aggregation-domain provenance (ISSUE 11), mirroring the
+        # sequential driver's stamps so f32/wire rows stay separable
+        # across execution modes.
+        comm_row["agg_domain"] = getattr(fr, "agg_domain", "f32")
+        comm_row["agg_domain_bits"] = (fr.codec.storage_bits
+                                       if comm_row["agg_domain"] == "wire"
+                                       else 32)
     if fr.packing is not None:
         # Lane-packing provenance (parallel/packed.py): static shared
         # config, stamped into every laned row like the codec accounting.

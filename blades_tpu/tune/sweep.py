@@ -555,10 +555,13 @@ def _packing_summary(row: Dict) -> Optional[Dict]:
 
 def _comm_summary(row: Dict) -> Optional[Dict]:
     """The comm subsystem's per-trial summary slice (codec byte
-    accounting is static per round, so the last row's values stand for
-    the whole trial)."""
+    accounting and the aggregation-domain provenance are static per
+    round, so the last row's values stand for the whole trial;
+    dequant_rows is a per-round planner constant under a fixed config)."""
     comm = {k: row[k] for k in ("comm_bytes_up", "codec_bits",
-                                "comm_compression_ratio") if k in row}
+                                "comm_compression_ratio", "agg_domain",
+                                "agg_domain_bits", "dequant_rows")
+            if k in row}
     return comm or None
 
 

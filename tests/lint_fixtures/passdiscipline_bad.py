@@ -1,8 +1,12 @@
 """Seeded streamed-pass-discipline violations: raw traversal primitives
 called outside the planner module — each call is a full HBM pass the
 planner can no longer fuse (bare import, aliased import, and attribute
-access through a module alias)."""
+access through a module alias) — plus the wire domain's decode-to-f32
+primitive (each call dequantizes the full packed matrix, reverting the
+wire domain's 4x HBM saving)."""
 
+from blades_tpu.comm import codecs as cc
+from blades_tpu.comm.codecs import dequantize
 from blades_tpu.parallel.streamed_geometry import gram, row_sq_norms
 from blades_tpu.parallel.streamed_geometry import weighted_row_sum as wrs
 from blades_tpu.parallel import streamed_geometry as sg
@@ -14,3 +18,9 @@ def stats(buf, w):
     out = wrs(buf, w, 1024)             # BAD: aliased primitive
     signs = sg.sign_counts(buf, 1024)   # BAD: module-attribute primitive
     return sq, g, out, signs
+
+
+def decode_all(q, scales):
+    dense = dequantize(q, scales)       # BAD: full-matrix decode to f32
+    dense2 = cc.dequantize(q, scales)   # BAD: module-attribute decode
+    return dense, dense2

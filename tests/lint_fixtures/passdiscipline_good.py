@@ -1,10 +1,18 @@
 """Clean twin of passdiscipline_bad.py: the same statistics submitted as
 planner requests — one fused traversal — plus a same-named helper from a
 DIFFERENT module (ops/layout.py's shard math), which must not
-false-positive."""
+false-positive, and the wire-domain dispatch (aggregate_wire: scale
+algebra instead of a full decode) with a deferred-decode method call on
+the codec CONFIG object (``decode_deferred`` returns the packed payload
+— it is not the raw decode primitive)."""
 
+from blades_tpu.comm.codecs import CodecConfig
 from blades_tpu.ops.layout import row_sq_norms as layout_row_sq_norms
-from blades_tpu.parallel.streamed_geometry import PassPlanner, chunk_grid
+from blades_tpu.parallel.streamed_geometry import (
+    PassPlanner,
+    aggregate_wire,
+    chunk_grid,
+)
 
 
 def stats(buf, w):
@@ -20,6 +28,15 @@ def stats(buf, w):
 def shard_norms(rows):
     # layout.py's row_sq_norms is per-shard math, not a buffer traversal.
     return layout_row_sq_norms(rows)
+
+
+def wire_round(agg, updates, residual, key):
+    # The sanctioned wire path: the payload stays packed; the planner's
+    # scale algebra dequantizes per STATISTIC, never the matrix.
+    codec = CodecConfig(name="quant", bits=8)
+    q, scales, residual = codec.decode_deferred(updates, residual, key)
+    out, state, sq = aggregate_wire(agg, q, scales)
+    return out, state, sq, residual
 
 
 def grid(d, c):

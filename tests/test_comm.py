@@ -358,7 +358,11 @@ def test_compressed_trial_streams_and_summarises_comm_metrics(tmp_path):
     want = uplink_bytes(6, d, get_codec(codec))
     assert s["comm"] == {"comm_bytes_up": want, "codec_bits": 8,
                          "comm_compression_ratio":
-                             round(6 * d * 4 / want, 4)}
+                             round(6 * d * 4 / want, 4),
+                         # Aggregation-domain provenance (ISSUE 11):
+                         # stamped whenever a codec is configured so
+                         # f32/wire A/B rows are separable.
+                         "agg_domain": "f32", "agg_domain_bits": 32}
     tdir = Path(s["dir"])
     assert schema_main([str(tdir / "metrics.jsonl")]) == 0
     rows = [json.loads(l)

@@ -149,9 +149,14 @@ def test_pass_discipline_fixtures():
     assert "gram()" in msgs
     assert "wrs()" in msgs           # aliased import resolves
     assert "sg.sign_counts()" in msgs  # module-attribute access
-    assert len(bad) == 4
-    # Clean twin: planner requests + layout.py's SAME-NAMED shard helper
-    # (a different module) produce nothing.
+    # Wire-domain decode discipline: the raw decode-to-f32 primitive is
+    # flagged through both the bare import and a codec-module alias.
+    assert "dequantize()" in msgs
+    assert "cc.dequantize()" in msgs
+    assert len(bad) == 6
+    # Clean twin: planner requests, layout.py's SAME-NAMED shard helper
+    # (a different module), and the sanctioned wire path (decode_deferred
+    # + aggregate_wire) produce nothing.
     assert run_fixture([PassDisciplinePass()],
                        "passdiscipline_good.py") == []
 

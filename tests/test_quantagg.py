@@ -1,0 +1,501 @@
+# blades-lint: disable-file=streamed-pass-discipline — equivalence tests exercise dequantize/raw references against the wire path on purpose
+"""Wire-domain robust aggregation (ISSUE 11): int8 defense geometry.
+
+Five layers:
+
+1. **Deferred decode** — ``decode_deferred``'s packed payload decodes
+   bit-identically to ``encode_decode`` (one quantization source of
+   truth), for int8 and int4 grids; forged rows re-enter the wire via
+   ``requantize_rows`` with benign payloads untouched.
+2. **int8 bundle kernel** — ``ops/pallas_rowstats`` on int8 input in
+   interpret mode: ragged tail widths, row padding to the int8 sublane
+   multiple, true-width sign counts on padded stripes, exact integer
+   Gram/norms.
+3. **Scale algebra** — a ``row_scale`` planner's accumulated statistics
+   match a plain planner over the dequantized matrix, per request kind,
+   on both the chunk path and the forced interpret-mode kernel.
+4. **Aggregators** — ``aggregate_wire`` vs decode-then-f32 for ALL 10
+   aggregators within the pinned tolerance (``WIRE_RTOL``;
+   Median/Trimmedmean exact — order statistics rank identical decoded
+   values).
+5. **Rounds + config + autotuner** — identity codec bit-identical
+   through the wire branch, quant wire rounds within tolerance of f32
+   rounds, post-codec (quantized-domain) forging, validate() gates,
+   schema-valid driver stamps, and the reassociating-tier-only
+   ``agg_domain`` plan knob with pack factors {2, 4, 8} probed at
+   enumeration.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from blades_tpu.comm.codecs import CodecConfig, dequantize
+from blades_tpu.ops.aggregators import (
+    Centeredclipping,
+    Clippedclustering,
+    DnC,
+    FLTrust,
+    GeoMed,
+    Mean,
+    Median,
+    Multikrum,
+    Signguard,
+    Trimmedmean,
+)
+from blades_tpu.ops.pallas_rowstats import row_stats_bundle
+from blades_tpu.parallel.streamed_geometry import (
+    PassPlanner,
+    PassRecorder,
+    WIRE_AGGREGATORS,
+    aggregate_wire,
+)
+
+# The pinned wire-domain equivalence tolerance (documented in README
+# "Communication codecs"): scale algebra is exact on the int8 grid, so
+# the only divergence vs decode-then-f32 is f32 reduction reassociation
+# — the same class the streamed chunk path carries.
+WIRE_RTOL = 1e-4
+
+
+def _payload(n=16, d=403, seed=0, bits=8):
+    rng = np.random.default_rng(seed)
+    u = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+    codec = CodecConfig(name="quant", bits=bits)
+    q, scales, _ = codec.decode_deferred(u, None, jax.random.PRNGKey(7))
+    return u, codec, q, scales
+
+
+# ---------------------------------------------------------------------------
+# 1. deferred decode
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("bits", [8, 4])
+def test_decode_deferred_bit_identical_to_encode_decode(bits):
+    u, codec, q, scales = _payload(bits=bits)
+    dec, _ = codec.encode_decode(u, None, jax.random.PRNGKey(7))
+    assert q.dtype == jnp.int8
+    smax = 2 ** (bits - 1) - 1
+    assert int(jnp.max(jnp.abs(q))) <= smax
+    np.testing.assert_array_equal(np.asarray(dequantize(q, scales)),
+                                  np.asarray(dec))
+
+
+def test_identity_decode_deferred_is_f32_passthrough():
+    u = jnp.asarray(np.random.default_rng(0).normal(size=(4, 9)),
+                    jnp.float32)
+    codec = CodecConfig(name="identity")
+    q, scales, _ = codec.decode_deferred(u, None, jax.random.PRNGKey(0))
+    assert scales is None
+    assert q is u
+    np.testing.assert_array_equal(np.asarray(dequantize(q, scales)),
+                                  np.asarray(u))
+
+
+def test_topk_has_no_deferred_mode():
+    codec = CodecConfig(name="topk", topk_ratio=0.5)
+    assert not codec.supports_deferred
+    with pytest.raises(ValueError, match="sparse f32"):
+        codec.decode_deferred(jnp.zeros((2, 8)), None, jax.random.PRNGKey(0))
+
+
+def test_requantize_rows_keeps_benign_payloads_exact():
+    u, codec, q, scales = _payload(n=8, d=57)
+    forged = dequantize(q, scales).at[:2].set(3.3)
+    mal = jnp.asarray([True, True] + [False] * 6)
+    q2, s2 = codec.requantize_rows(forged, q, scales, mal)
+    # Benign rows: untouched packed payloads, bit for bit.
+    np.testing.assert_array_equal(np.asarray(q2[2:]), np.asarray(q[2:]))
+    np.testing.assert_array_equal(np.asarray(s2[2:]), np.asarray(scales[2:]))
+    # Malicious rows: on-grid (round-to-nearest of a constant row is the
+    # top grid level, so the decode is exact here).
+    np.testing.assert_allclose(np.asarray(dequantize(q2, s2)[:2]),
+                               np.full((2, 57), 3.3), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# 2. int8 bundle kernel (interpret mode)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n,d", [(12, 777), (8, 512), (5, 130)])
+def test_int8_bundle_interpret_matches_numpy(n, d):
+    """Ragged widths (777 = stripe + tail, 130 << stripe), row counts
+    off the int8 sublane multiple (12, 5 pad to 32): the int8 kernel's
+    integer accumulators match exact integer arithmetic."""
+    rng = np.random.default_rng(3)
+    q = rng.integers(-127, 128, size=(n, d)).astype(np.int8)
+    v = rng.normal(size=(2, d)).astype(np.float32)
+    w = rng.normal(size=(1, n)).astype(np.float32)
+    out = row_stats_bundle(jnp.asarray(q), sq=True, gram=True, signs=True,
+                           dots=jnp.asarray(v), weights=jnp.asarray(w),
+                           gram_dot=jnp.asarray(w), d_true=d,
+                           interpret=True)
+    qf = q.astype(np.float64)
+    # Self-contractions are EXACT (int32 stripe sums): compare tight.
+    np.testing.assert_allclose(np.asarray(out["sq"]), (qf * qf).sum(1),
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(out["gram"]), qf @ qf.T, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(out["dots"]),
+                               qf @ v.astype(np.float64).T, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(out["wsum"]),
+                               w.astype(np.float64) @ qf, rtol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(out["gram_dot"]),
+        qf @ (w.astype(np.float64) @ qf).T, rtol=1e-4)
+
+
+def test_int8_sign_counts_true_width_on_padded_stripes():
+    """d_true < allocated width: zero counts derive from d_true, so the
+    stripe-alignment padding columns never miscount — and an all-zero
+    row reports d_true zeros."""
+    rng = np.random.default_rng(5)
+    n, d_true, d_alloc = 6, 100, 512
+    q = np.zeros((n, d_alloc), np.int8)
+    q[:, :d_true] = rng.integers(-3, 4, size=(n, d_true))
+    q[0, :] = 0  # all-zero row (scale 0 in the wire payload)
+    out = row_stats_bundle(jnp.asarray(q), signs=True, d_true=d_true,
+                           interpret=True)
+    ref = np.stack([(q[:, :d_true] > 0).sum(1), (q[:, :d_true] < 0).sum(1),
+                    (q[:, :d_true] == 0).sum(1)], axis=1)
+    np.testing.assert_array_equal(np.asarray(out["signs"]), ref)
+    assert np.asarray(out["signs"])[0, 2] == d_true
+
+
+def test_kernel_gate_int8_row_alignment():
+    from blades_tpu.ops.pallas_rowstats import kernel_applicable
+
+    # The envelope itself is backend-gated; on CPU everything is False,
+    # so only assert the int8-specific row-alignment DIFFERENCE: an n
+    # that passes the float gate must fail the integer gate unless it is
+    # a multiple of 32.
+    for n in (8, 24, 40):
+        assert not kernel_applicable(n, 1 << 20, integer=True) or n % 32 == 0
+
+
+# ---------------------------------------------------------------------------
+# 3. planner scale algebra
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("use_kernel", [False, True])
+def test_row_scale_planner_matches_dequantized_planner(use_kernel):
+    u, codec, q, scales = _payload(n=16, d=403)
+    dec = dequantize(q, scales)
+    rng = np.random.default_rng(1)
+    v = jnp.asarray(rng.normal(size=(403,)).astype(np.float32))
+    w = jnp.asarray(rng.normal(size=(16,)).astype(np.float32))
+    kinds = ("sq", "gram", "signs", "dots", "wsum", "gram_dot")
+    pw = PassPlanner(q, 97, row_scale=scales, use_kernel=use_kernel,
+                     interpret=use_kernel)
+    pf = PassPlanner(dec, 97, use_kernel=False)
+    hw = [pw.sq_norms(), pw.gram(), pw.sign_counts(), pw.dots(v),
+          pw.weighted_sum(w), pw.gram_dot(w)]
+    hf = [pf.sq_norms(), pf.gram(), pf.sign_counts(), pf.dots(v),
+          pf.weighted_sum(w), pf.gram_dot(w)]
+    pw.execute()
+    pf.execute()
+    for kind, a, b in zip(kinds, hw, hf):
+        np.testing.assert_allclose(
+            np.asarray(a.value), np.asarray(b.value),
+            rtol=2e-4, atol=1e-3, err_msg=kind)
+
+
+def test_row_scale_chunk_only_requests_dequantize_in_flight():
+    u, codec, q, scales = _payload(n=10, d=211)
+    dec = dequantize(q, scales)
+    mal = jnp.asarray([True] * 3 + [False] * 7)
+    idx = jnp.asarray([0, 5, 210, 100], jnp.int32)
+    ones = jnp.ones((10,), jnp.float32)
+    pw = PassPlanner(q, 64, row_scale=scales)
+    pf = PassPlanner(dec, 64)
+    kw = dict(mask=~mal, row_scale=ones)
+    hw = [pw.gather(idx), pw.col_mean_std(mal),
+          pw.masked_median(**kw), pw.coordwise(Median())]
+    hf = [pf.gather(idx), pf.col_mean_std(mal),
+          pf.masked_median(**kw), pf.coordwise(Median())]
+    pw.execute()
+    pf.execute()
+    np.testing.assert_allclose(np.asarray(hw[0].value),
+                               np.asarray(hf[0].value), rtol=1e-6)
+    for a, b in zip(hw[1].value, hf[1].value):  # (mean, std)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=1e-5)
+    # Order statistics rank the identical decoded values: exact.
+    np.testing.assert_array_equal(np.asarray(hw[2].value),
+                                  np.asarray(hf[2].value))
+    np.testing.assert_array_equal(np.asarray(hw[3].value),
+                                  np.asarray(hf[3].value))
+
+
+def test_dequant_rows_accounting():
+    u, codec, q, scales = _payload(n=10, d=211)
+    rec = PassRecorder()
+    p = PassPlanner(q, 64, row_scale=scales, recorder=rec)
+    p.weighted_sum(jnp.ones((10,), jnp.float32))
+    p.sq_norms()
+    p.gram()
+    p.execute()
+    # Only the weighted sum materializes a decoded row; the algebraic
+    # statistics count zero.
+    assert rec.dequant_rows == 1
+    assert (rec.executed, rec.unfused) == (1, 3)
+
+
+# ---------------------------------------------------------------------------
+# 4. per-aggregator equivalence (the pinned tolerance)
+# ---------------------------------------------------------------------------
+
+
+def _agg_zoo():
+    return [Mean(), Median(), Trimmedmean(num_byzantine=2), GeoMed(),
+            Multikrum(num_byzantine=2, k=3),
+            DnC(num_byzantine=2, sub_dim=50), Centeredclipping(),
+            Signguard(), Clippedclustering(), FLTrust()]
+
+
+@pytest.mark.parametrize("agg", _agg_zoo(), ids=lambda a: type(a).__name__)
+def test_aggregate_wire_matches_decode_then_f32(agg):
+    n, d = 16, 403
+    u, codec, q, scales = _payload(n=n, d=d)
+    dec = dequantize(q, scales)
+    key = jax.random.PRNGKey(3)
+    trusted = jnp.asarray(
+        np.random.default_rng(9).normal(size=(d,)).astype(np.float32))
+    st = agg.init(d, n)
+    if isinstance(agg, FLTrust):
+        ref, _ = agg(jnp.concatenate([dec, trusted[None]], 0), st, key=key)
+    else:
+        ref, _ = agg(dec, st, key=key)
+    out, _, sq = aggregate_wire(agg, q, scales, state=st, key=key,
+                                trusted=trusted, d_chunk=128)
+    if isinstance(agg, (Median, Trimmedmean)):
+        # Order statistics over identical decoded values: EXACT.
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    else:
+        scale = float(jnp.max(jnp.abs(ref))) + 1e-12
+        err = float(jnp.max(jnp.abs(out - ref))) / scale
+        assert err <= WIRE_RTOL, (type(agg).__name__, err)
+    np.testing.assert_allclose(np.asarray(sq), (np.asarray(dec) ** 2).sum(1),
+                               rtol=2e-4)
+
+
+def test_aggregate_wire_identity_payload_runs_unscaled():
+    """scales=None (the identity wire): the planner runs plain f32
+    statistics — same values as a row_scale of ones, no scaling steps."""
+    u, _, _, _ = _payload(n=8, d=100)
+    out, _, sq = aggregate_wire(Mean(), u, None, d_chunk=64)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(u).mean(0),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_wire_aggregators_covers_all_ten():
+    assert len(WIRE_AGGREGATORS) == 10
+    for agg in _agg_zoo():
+        assert isinstance(agg, WIRE_AGGREGATORS)
+
+
+# ---------------------------------------------------------------------------
+# 5. rounds, config gates, driver stamps, autotuner knob
+# ---------------------------------------------------------------------------
+
+
+def _round_pair(aggname, codec, n=8, f=2, adversary=None):
+    from blades_tpu.adversaries import get_adversary, make_malicious_mask
+    from blades_tpu.core import FedRound, Server, TaskSpec
+
+    task = TaskSpec(model="mlp", input_shape=(8, 8, 1), num_classes=10,
+                    lr=0.1).build()
+    server = Server.from_config(aggregator=aggname, num_byzantine=f, lr=0.5)
+    adv = (get_adversary(adversary, num_clients=n, num_byzantine=f)
+           if adversary else None)
+    base = dict(task=task, server=server, adversary=adv, batch_size=4,
+                num_batches_per_round=1, codec=codec, agg_d_chunk=1 << 10)
+    fr32 = FedRound(**base, agg_domain="f32")
+    frw = FedRound(**base, agg_domain="wire")
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(n, 12, 8, 8, 1)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 10, size=(n, 12)), jnp.int32)
+    ln = jnp.full((n,), 12, jnp.int32)
+    mal = make_malicious_mask(n, f)
+    st = fr32.init(jax.random.PRNGKey(0), n)
+    k = jax.random.PRNGKey(1)
+    s32, m32 = jax.jit(fr32.step)(st, x, y, ln, mal, k)
+    sw, mw = jax.jit(frw.step)(st, x, y, ln, mal, k)
+    return (s32, m32), (sw, mw)
+
+
+def test_identity_codec_wire_round_bit_identical():
+    (s32, m32), (sw, mw) = _round_pair("Multikrum",
+                                       CodecConfig(name="identity"))
+    for a, b in zip(jax.tree.leaves(s32), jax.tree.leaves(sw)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for k in m32:
+        np.testing.assert_array_equal(np.asarray(m32[k]), np.asarray(mw[k]))
+
+
+# Whole-round compiles are the expensive part (PR 7 budget convention):
+# Multikrum is the headline tier-1 case (geometry + selection through the
+# planner); the Mean variant re-proves what the per-aggregator
+# equivalence layer already covers, so it rides the slow zoo.
+@pytest.mark.parametrize("aggname", [
+    pytest.param("Mean", marks=pytest.mark.slow), "Multikrum"])
+def test_quant_wire_round_matches_f32_round(aggname):
+    (s32, m32), (sw, mw) = _round_pair(aggname,
+                                       CodecConfig(name="quant", bits=8))
+    for a, b in zip(jax.tree.leaves(s32.server.params),
+                    jax.tree.leaves(sw.server.params)):
+        scale = float(jnp.max(jnp.abs(a))) + 1e-12
+        assert float(jnp.max(jnp.abs(a - b))) / scale <= WIRE_RTOL
+    assert float(m32["train_loss"]) == float(mw["train_loss"])
+    np.testing.assert_allclose(float(m32["update_norm_mean"]),
+                               float(mw["update_norm_mean"]), rtol=1e-4)
+    # The wire round stamps the planner's traversal accounting.
+    assert int(mw["hbm_passes"]) < int(mw["hbm_passes_unfused"])
+    assert int(mw["dequant_rows"]) >= 1
+    assert "hbm_passes" not in m32
+
+
+def test_wire_round_forges_post_codec_in_quantized_domain():
+    """ALIE under the wire domain: the forge reads the full quantized
+    geometry (dequant_rows includes the n-row materialization) and the
+    round stays finite and robust-aggregated."""
+    (_, m32), (sw, mw) = _round_pair(
+        "Multikrum", CodecConfig(name="quant", bits=8), adversary="ALIE")
+    assert np.isfinite(float(mw["agg_norm"]))
+    assert int(mw["dequant_rows"]) >= 8  # the forge's full decode
+    # Quantized forged rows differ from the f32 domain's full-precision
+    # ones by at most the wire grid's resolution — the aggregate stays
+    # in the same place.
+    np.testing.assert_allclose(float(mw["agg_norm"]), float(m32["agg_norm"]),
+                               rtol=0.05)
+
+
+def _wire_config(**over):
+    from blades_tpu.algorithms import FedavgConfig
+
+    cfg = (FedavgConfig()
+           .data(dataset="mnist", num_clients=8, seed=1)
+           .training(global_model="mlp",
+                     aggregator={"type": "Multikrum", "num_byzantine": 2,
+                                 "k": 3})
+           .adversary(num_malicious_clients=2,
+                      adversary_config={"type": "ALIE"})
+           .communication(codec={"type": "quant", "bits": 8},
+                          agg_domain="wire")
+           .evaluation(evaluation_interval=0))
+    for k, v in over.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+def test_validate_gates_wire_domain():
+    from blades_tpu.algorithms import FedavgConfig
+
+    with pytest.raises(ValueError, match="deferrable codec"):
+        _wire_config(codec_config=None).validate()
+    with pytest.raises(ValueError, match="deferrable codec"):
+        _wire_config(codec_config={"type": "topk"}).validate()
+    with pytest.raises(ValueError, match="fault injection"):
+        _wire_config(fault_config={"dropout_rate": 0.3}).validate()
+    with pytest.raises(ValueError, match="health check"):
+        _wire_config(health_check=True).validate()
+    with pytest.raises(ValueError, match="forensics"):
+        _wire_config(forensics=True).validate()
+    with pytest.raises(ValueError, match="DP"):
+        _wire_config(dp_clip_threshold=1.0).validate()
+    with pytest.raises(ValueError, match="agg_domain"):
+        _wire_config(agg_domain="int8").validate()
+    # f32 domain with any codec stays valid (the pre-PR surface).
+    cfg = _wire_config()
+    cfg.agg_domain = "f32"
+    cfg.validate()
+
+
+def test_driver_stamps_wire_provenance_schema_valid():
+    from blades_tpu.obs.schema import validate_record
+
+    algo = _wire_config().build()
+    row = algo.train()
+    assert row["agg_domain"] == "wire"
+    assert row["agg_domain_bits"] == 8
+    assert row["dequant_rows"] >= 8
+    assert row["hbm_passes"] >= 1
+    validate_record({"experiment": "e", "trial": "t",
+                     **{k: v for k, v in row.items()}})
+    # f32-domain rows under the same codec stamp the domain too, with
+    # no dequant counter (nothing was packed).
+    cfg = _wire_config()
+    cfg.agg_domain = "f32"
+    row32 = cfg.build().train()
+    assert row32["agg_domain"] == "f32"
+    assert row32["agg_domain_bits"] == 32
+    assert "dequant_rows" not in row32
+
+
+def test_autotune_agg_domain_reassociating_tier_only():
+    from blades_tpu.perf import autotune as at
+
+    space = at.enumerate_plans(
+        executions=["dense"], d_chunks=[1 << 17],
+        agg_domains=("f32", "wire"), allow_reassociating=True)
+    wire = [p for p in space.candidates if p.agg_domain == "wire"]
+    assert wire and all(p.tier == at.REASSOCIATING_TIER for p in wire)
+    assert space.baseline.agg_domain == "f32"
+    # plan_id stays byte-identical for f32 plans; wire plans are marked.
+    assert "|wire" not in space.baseline.plan_id
+    assert all(p.plan_id.endswith("|wire") for p in wire)
+    # The default tier can never be handed a wire plan.
+    space_def = at.enumerate_plans(
+        executions=["dense"], d_chunks=[1 << 17],
+        agg_domains=("f32", "wire"), allow_reassociating=False)
+    assert all(p.agg_domain == "f32" for p in space_def.candidates)
+    # apply_plan materialises the knob.
+    cfg = _wire_config()
+    cfg.agg_domain = "f32"
+    at.apply_plan(cfg, wire[0])
+    assert cfg.agg_domain == "wire"
+
+
+def test_driver_plan_space_offers_wire_and_probed_packs():
+    """The built driver's reassociating plan space: agg_domain=wire
+    appears (quant codec, no f32-only features), pack factors come from
+    the {2,4,8} probe with impossible factors dropped at enumeration
+    (8 clients: every probed factor divides, but the resolver vetoes
+    what the model cannot pack), and heuristic selection on CPU stays
+    rank 0 — the f32 baseline."""
+    from blades_tpu.algorithms import FedavgConfig
+
+    cfg = (FedavgConfig()
+           .data(dataset="mnist", num_clients=8, seed=1)
+           .training(global_model="mlp",
+                     aggregator={"type": "Multikrum", "num_byzantine": 2,
+                                 "k": 3})
+           .adversary(num_malicious_clients=2,
+                      adversary_config={"type": "ALIE"})
+           .communication(codec={"type": "quant", "bits": 8})
+           .evaluation(evaluation_interval=0))
+    algo = cfg.build()
+    space = algo._plan_space(allow_reassociating=True)
+    domains = {p.agg_domain for p in space.candidates}
+    assert domains == {"f32", "wire"}
+    assert space.baseline.agg_domain == "f32"
+    assert all(p.tier == "reassociating" for p in space.candidates
+               if p.agg_domain == "wire")
+    assert all(p.client_packing in (1, 2, 4, 8) for p in space.candidates)
+    # Default tier never offers wire.
+    space_def = algo._plan_space(allow_reassociating=False)
+    assert {p.agg_domain for p in space_def.candidates} == {"f32"}
+    # Explicit agg_domain pins the list even under the opt-in tier (the
+    # fluent setter records explicitness; _wire_config set it to "wire"
+    # then we flip the value back, keeping the explicit mark).
+    cfg2 = _wire_config()
+    cfg2.agg_domain = "f32"
+    assert "agg_domain" in cfg2._explicit
+    algo2 = cfg2.build()
+    space2 = algo2._plan_space(allow_reassociating=True)
+    assert {p.agg_domain for p in space2.candidates} == {"f32"}

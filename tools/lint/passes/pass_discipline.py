@@ -11,13 +11,21 @@ module silently re-introduces a dedicated pass per statistic — the exact
 regression the ``hbm_passes`` metric was added to catch, enforced here
 statically like donation and host-sync.
 
+The same discipline covers the wire domain's decode-to-f32 primitive
+(``blades_tpu.comm.codecs.dequantize``): a wire-domain round aggregates
+the PACKED int8 payload, and a stray full-matrix ``dequantize()`` call
+outside the codec module and the planner module silently reverts its 4x
+HBM saving — the regression the ``dequant_rows`` metric counts.  The
+one sanctioned non-planner site (the round's forge materialization in
+``core/round.py``) carries the pragma with its justification.
+
 Detection is import-based, so same-named helpers in other modules
 (``ops/layout.py`` has its own ``row_sq_norms``/``row_dots`` for the
 d-sharded shard math) never false-positive: a call is flagged only when
-the name was imported from the planner module, or accessed as an
-attribute of it.  Reference/property tests that exercise the raw
-primitives on purpose carry the unified pragma
-(``# blades-lint: disable=streamed-pass-discipline — <why>``).
+the name was imported from the planner module (or the codec module, for
+``dequantize``), or accessed as an attribute of it.  Reference/property
+tests that exercise the raw primitives on purpose carry the unified
+pragma (``# blades-lint: disable=streamed-pass-discipline — <why>``).
 """
 
 from __future__ import annotations
@@ -32,6 +40,15 @@ from tools.lint.core import Finding, LintContext, LintPass
 PLANNER_MODULE = "blades_tpu/parallel/streamed_geometry.py"
 _MODULE_DOTTED = "blades_tpu.parallel.streamed_geometry"
 _PARENT_DOTTED = "blades_tpu.parallel"
+
+#: The codec module — home of the wire domain's decode-to-f32 primitive.
+#: ``dequantize`` may be spelled there and in the planner module (whose
+#: scale algebra IS the sanctioned dequantization); anywhere else a call
+#: is a full-matrix f32 materialization that defeats the wire domain.
+CODEC_MODULE = "blades_tpu/comm/codecs.py"
+_CODEC_DOTTED = "blades_tpu.comm.codecs"
+_CODEC_PARENT = "blades_tpu.comm"
+RAW_DECODERS = frozenset({"dequantize"})
 
 #: Raw single-statistic traversal primitives (each call = one full HBM
 #: pass).  ``aggregate_streamed`` / ``forge_streamed`` /
@@ -56,19 +73,30 @@ _HINT = ("submit the statistic as a PassPlanner request "
          "other traversals, or pragma the line if it is a deliberate "
          "reference-path use")
 
+_DECODE_HINT = ("aggregate the packed payload through "
+                "streamed_geometry.aggregate_wire (the planner applies "
+                "the wire scales algebraically, per statistic) instead "
+                "of materializing the dense f32 matrix, or pragma the "
+                "line if the full decode is deliberate and counted")
+
 
 class PassDisciplinePass(LintPass):
     name = "streamed-pass-discipline"
-    doc = ("raw streamed_geometry traversal primitives called outside "
-           "the pass planner module")
+    doc = ("raw streamed_geometry traversal primitives (and the codec "
+           "decode-to-f32 primitive) called outside the pass planner / "
+           "codec modules")
 
     def run(self, ctx: LintContext) -> Iterable[Finding]:
         findings: List[Finding] = []
         for src in ctx.files:
             if src.rel == PLANNER_MODULE or src.tree is None:
                 continue
-            fn_aliases, mod_aliases = self._imports(src.tree)
-            if not fn_aliases and not mod_aliases:
+            in_codec = src.rel == CODEC_MODULE
+            fn_aliases, mod_aliases, dec_aliases, codec_mods = \
+                self._imports(src.tree)
+            if in_codec:
+                dec_aliases, codec_mods = {}, set()
+            if not (fn_aliases or mod_aliases or dec_aliases or codec_mods):
                 continue
             for call in astutil.walk_calls(src.tree):
                 cn = astutil.call_name(call)
@@ -81,6 +109,13 @@ class PassDisciplinePass(LintPass):
                         "pass) outside the pass planner module",
                         fix_hint=_HINT))
                     continue
+                if cn in dec_aliases:
+                    findings.append(Finding(
+                        self.name, src.rel, call.lineno,
+                        f"raw decode-to-f32 call {cn}() (full-matrix "
+                        "dequantization) outside the codec/planner "
+                        "modules", fix_hint=_DECODE_HINT))
+                    continue
                 head, _, tail = cn.rpartition(".")
                 if tail in RAW_PRIMITIVES and head in mod_aliases:
                     findings.append(Finding(
@@ -88,15 +123,23 @@ class PassDisciplinePass(LintPass):
                         f"direct raw-traversal call {cn}() (one full HBM "
                         "pass) outside the pass planner module",
                         fix_hint=_HINT))
+                elif tail in RAW_DECODERS and head in codec_mods:
+                    findings.append(Finding(
+                        self.name, src.rel, call.lineno,
+                        f"raw decode-to-f32 call {cn}() (full-matrix "
+                        "dequantization) outside the codec/planner "
+                        "modules", fix_hint=_DECODE_HINT))
         return findings
 
     @staticmethod
     def _imports(tree: ast.Module) -> tuple:
-        """(primitive-name aliases, planner-module aliases) bound in this
-        file — including ``import ... as`` renames and the dotted module
-        path itself."""
+        """(primitive aliases, planner-module aliases, decoder aliases,
+        codec-module aliases) bound in this file — including
+        ``import ... as`` renames and the dotted module paths."""
         fn_aliases: Dict[str, str] = {}
         mod_aliases: Set[str] = set()
+        dec_aliases: Dict[str, str] = {}
+        codec_mods: Set[str] = set()
         for node in ast.walk(tree):
             if isinstance(node, ast.ImportFrom):
                 if node.module == _MODULE_DOTTED:
@@ -107,8 +150,18 @@ class PassDisciplinePass(LintPass):
                     for alias in node.names:
                         if alias.name == "streamed_geometry":
                             mod_aliases.add(alias.asname or alias.name)
+                elif node.module == _CODEC_DOTTED:
+                    for alias in node.names:
+                        if alias.name in RAW_DECODERS:
+                            dec_aliases[alias.asname or alias.name] = alias.name
+                elif node.module == _CODEC_PARENT:
+                    for alias in node.names:
+                        if alias.name == "codecs":
+                            codec_mods.add(alias.asname or alias.name)
             elif isinstance(node, ast.Import):
                 for alias in node.names:
                     if alias.name == _MODULE_DOTTED:
                         mod_aliases.add(alias.asname or alias.name)
-        return fn_aliases, mod_aliases
+                    elif alias.name == _CODEC_DOTTED:
+                        codec_mods.add(alias.asname or alias.name)
+        return fn_aliases, mod_aliases, dec_aliases, codec_mods
