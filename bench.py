@@ -630,6 +630,134 @@ def _quantagg_block(cpu: bool) -> dict:
     return out
 
 
+def _measure_traced_cnn(traced: bool, *, num_clients=32, timed_rounds=4,
+                        model="cnn", input_shape=(32, 32, 3)) -> dict:
+    """One arm of the BLADES_BENCH_TRACE A/B: the 32-client dense CNN
+    protocol (FedAvg + ALIE forge + exact Median) with the driver-style
+    per-round fetch, either bare or under the FULL observability layer
+    — armed span tracer (round spans + jax profiler annotations),
+    armed watchdog observing every fetched row, flight recorder
+    recording every row.  BOTH arms fetch the round scalars each round
+    (exactly what the sweep driver does), so the delta is the tracing/
+    watchdog overhead alone — the watchdog's zero-extra-device-syncs
+    contract measured, not asserted."""
+    from blades_tpu.adversaries import get_adversary, make_malicious_mask
+    from blades_tpu.core import FedRound, Server, TaskSpec
+    from blades_tpu.obs.flightrec import FlightRecorder
+    from blades_tpu.obs.trace import Tracer
+    from blades_tpu.obs.watchdog import Watchdog
+
+    num_byzantine = num_clients // 4
+    task = TaskSpec(model=model, input_shape=input_shape, num_classes=10,
+                    lr=0.1).build()
+    server = Server.from_config(aggregator="Median", lr=0.5)
+    adv = get_adversary("ALIE", num_clients=num_clients,
+                        num_byzantine=num_byzantine)
+    fr = FedRound(task=task, server=server, adversary=adv,
+                  batch_size=min(BATCH, 8),
+                  num_batches_per_round=LOCAL_STEPS)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(num_clients, 8, *input_shape)),
+                    jnp.float32)
+    y = jnp.asarray(rng.integers(0, 10, size=(num_clients, 8)), jnp.int32)
+    lengths = jnp.full((num_clients,), 8, jnp.int32)
+    mal = make_malicious_mask(num_clients, num_byzantine)
+    state = fr.init(jax.random.PRNGKey(0), num_clients)
+    step = jax.jit(fr.step, donate_argnums=(0,))
+
+    tracer = Tracer(record=True) if traced else None
+    wd = Watchdog() if traced else None
+    import tempfile
+
+    flightrec = (FlightRecorder(
+        os.path.join(tempfile.mkdtemp(prefix="blades_trace_ab_"),
+                     "flightrec.json"),
+        capacity=8, trial="bench_trace_ab", algo="FEDAVG")
+        if traced else None)
+
+    def one_round(r, key):
+        nonlocal state
+        state, m = step(state, x, y, lengths, mal, key)
+        # Driver-style per-round fetch: BOTH arms pay this sync.
+        row = {
+            "training_iteration": r + 1,
+            "train_loss": float(m["train_loss"]),
+            "agg_norm": float(m["agg_norm"]),
+            "update_norm_mean": float(m["update_norm_mean"]),
+        }
+        if traced:
+            events = wd.observe(row)
+            flightrec.record(row)
+            if events or flightrec.check(row):
+                flightrec.dump({"kind": "watchdog", "round": r + 1})
+        return row
+
+    # Warmup / compile outside the timed loop.
+    if traced:
+        with tracer.span("compile", step=0):
+            row = one_round(-1, jax.random.PRNGKey(1))
+    else:
+        row = one_round(-1, jax.random.PRNGKey(1))
+    t0 = time.perf_counter()
+    for r in range(timed_rounds):
+        key = jax.random.fold_in(jax.random.PRNGKey(2), r)
+        if traced:
+            with tracer.span("round", step=r + 1):
+                row = one_round(r, key)
+        else:
+            row = one_round(r, key)
+    dt = time.perf_counter() - t0
+    assert row["train_loss"] == row["train_loss"]  # NaN guard
+    out = {
+        "rounds_per_sec": round(timed_rounds / dt, 4),
+        "round_s": round(dt / timed_rounds, 4),
+        "clients": num_clients, "byzantine": num_byzantine,
+        "model": model, "timed_rounds": timed_rounds,
+        "aggregator": "Median", "adversary": "ALIE",
+        "traced": traced,
+    }
+    if traced:
+        out["watchdog_events"] = len(wd.events)
+        out["round_spans"] = int(
+            tracer.summary().get("round", {}).get("count", 0))
+    return out
+
+
+def _trace_block(cpu: bool) -> dict:
+    """BLADES_BENCH_TRACE satellite (ISSUE 12): round wall-time with the
+    observability layer fully armed (span tracer + watchdog + flight
+    recorder) vs bare, on the 32-client dense CNN protocol — the
+    acceptance is overhead < 2% with the watchdog armed.  Rides the
+    TPU-probe + cpu_fallback machinery like the other A/Bs; on the
+    2-core fallback box the measurement is noisy (rounds are ~seconds,
+    the layer costs ~microseconds), so the stamped numbers — not the
+    threshold — are the record there."""
+    if cpu:
+        # ~70 ms mlp rounds on the 2-core box: 3 rounds is pure timer
+        # noise (observed swings of +/-8% either direction); 30 rounds
+        # keeps the arm under ~5 s while averaging the scheduler out.
+        kw = dict(model="mlp", input_shape=(8, 8, 1), num_clients=16,
+                  timed_rounds=30)
+    else:
+        kw = dict(model="cnn", input_shape=(32, 32, 3), num_clients=32,
+                  timed_rounds=5)
+    bare = _measure_traced_cnn(False, **kw)
+    traced = _measure_traced_cnn(True, **kw)
+    overhead_pct = None
+    if traced["rounds_per_sec"]:
+        overhead_pct = round(
+            (bare["rounds_per_sec"] / traced["rounds_per_sec"] - 1.0)
+            * 100.0, 3)
+    return {
+        "bare": bare,
+        "traced": traced,
+        "overhead_pct": overhead_pct,
+        "acceptance": "overhead < 2% with the watchdog armed",
+        "acceptance_met": (overhead_pct is not None
+                           and overhead_pct < 2.0),
+    }
+
+
 def _measure_autotuned(tuned: bool, plan_cache_dir: str, *, num_clients,
                        model, dataset, input_shape, timed_rounds) -> dict:
     """One config-driven run of the bench protocol through the FULL
@@ -769,6 +897,13 @@ def _cpu_fallback(probe_err: str) -> None:
             out["quantagg"] = _quantagg_block(cpu=True)
         except Exception as e:
             out["quantagg"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+    if os.environ.get("BLADES_BENCH_TRACE", "1") == "1":
+        try:
+            # Observability-overhead A/B (ISSUE 12) on the reduced CPU
+            # config — span tracer + watchdog + flightrec armed vs bare.
+            out["trace"] = _trace_block(cpu=True)
+        except Exception as e:
+            out["trace"] = {"error": f"{type(e).__name__}: {e}"[:300]}
     _emit(out)
 
 
@@ -867,6 +1002,16 @@ def main() -> None:
             out["quantagg"] = _quantagg_block(cpu=False)
         except Exception as e:
             out["quantagg"] = {"error": f"{type(e).__name__}: {e}"[:500]}
+
+    if os.environ.get("BLADES_BENCH_TRACE", "1") == "1":
+        try:
+            # Observability-overhead A/B (ISSUE 12): the 32-client dense
+            # CNN protocol with the span tracer + anomaly watchdog +
+            # flight recorder fully armed vs bare — acceptance: overhead
+            # < 2% with the watchdog armed.
+            out["trace"] = _trace_block(cpu=False)
+        except Exception as e:
+            out["trace"] = {"error": f"{type(e).__name__}: {e}"[:500]}
 
     _emit(out)
 

@@ -18,7 +18,6 @@ SURVEY.md §5).
 from __future__ import annotations
 
 import pickle
-import time
 import warnings
 from dataclasses import replace as _dc_replace
 from pathlib import Path
@@ -31,8 +30,8 @@ import numpy as np
 from blades_tpu.adversaries import make_malicious_mask
 from blades_tpu.core import FedRound
 from blades_tpu.data import DatasetCatalog
+from blades_tpu.obs.trace import Timers
 from blades_tpu.perf.async_metrics import DEVICE_METRICS_KEY
-from blades_tpu.utils.timers import Timers
 
 
 class Fedavg:
@@ -791,6 +790,16 @@ class Fedavg:
     @property
     def iteration(self) -> int:
         return self._iteration
+
+    def adopt_tracer(self, tracer) -> None:
+        """Observability layer (obs/trace.py): replace this instance's
+        phase timers with the caller's span tracer, so the
+        ``training_step`` / ``evaluate`` phases nest inside the
+        caller's trial/round spans (ONE tree per trial in the
+        ``--trace-dir`` export).  The tracer's ``summary()`` shape is a
+        superset of the old ``Timers`` one, so the per-row ``timers``
+        field keeps its contract."""
+        self.timers = tracer
 
     @property
     def plan(self):

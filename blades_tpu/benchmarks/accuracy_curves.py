@@ -19,8 +19,9 @@ from __future__ import annotations
 
 import argparse
 import json
-import time
 from pathlib import Path
+
+from blades_tpu.obs.trace import now
 
 DEFAULT_AGGREGATORS = ["Mean", "Median", "Trimmedmean", "GeoMed", "Multikrum",
                        "Signguard", "Clippedclustering"]
@@ -238,7 +239,7 @@ def main(argv=None) -> int:
         for m in args.malicious:
             if (agg, m) in done:
                 continue
-            t0 = time.perf_counter()
+            t0 = now()
             row = run_cell(args.dataset, model, agg, m, args.adversary,
                            args.rounds, args.seed, args.num_clients,
                            args.rounds_per_dispatch,
@@ -250,7 +251,7 @@ def main(argv=None) -> int:
                            server_lr=args.server_lr,
                            batch_size=args.batch_size,
                            compute_dtype=args.compute_dtype)
-            row["wall_s"] = round(time.perf_counter() - t0, 1)
+            row["wall_s"] = round(now() - t0, 1)
             rows.append(row)
             print(json.dumps(row), flush=True)
             write_table()

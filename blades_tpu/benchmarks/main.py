@@ -12,12 +12,13 @@ debugging the federation around it.
 from __future__ import annotations
 
 import argparse
-import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+
+from blades_tpu.obs.trace import now
 
 
 def main(argv=None) -> int:
@@ -66,7 +67,7 @@ def main(argv=None) -> int:
     rng = np.random.default_rng(args.seed)
     for epoch in range(args.epochs):
         perm = rng.permutation(n)[: steps_per_epoch * args.batch_size]
-        t0, tot = time.perf_counter(), 0.0
+        t0, tot = now(), 0.0
         for i in range(steps_per_epoch):
             idx = perm[i * args.batch_size : (i + 1) * args.batch_size]
             key = jax.random.fold_in(jax.random.PRNGKey(args.seed), epoch * steps_per_epoch + i)
@@ -75,7 +76,7 @@ def main(argv=None) -> int:
         test_acc = float(accuracy(params, jnp.asarray(ds.test_x), jnp.asarray(ds.test_y)))
         print(
             f"epoch {epoch}: loss={tot / steps_per_epoch:.4f} "
-            f"test_acc={test_acc:.4f} ({time.perf_counter() - t0:.1f}s)",
+            f"test_acc={test_acc:.4f} ({now() - t0:.1f}s)",
             flush=True,
         )
     return 0

@@ -237,10 +237,14 @@ class FedRound:
         bit-for-bit (regression-tested per aggregator in
         ``tests/test_perf.py``)."""
         k_sample = jax.random.split(key, 5)[0]
-        return sample_client_batches(
-            k_sample, data_x, data_y, lengths, self.batch_size,
-            self.num_batches_per_round,
-        )
+        # named_scope: trace-time HLO metadata only (numerics untouched)
+        # — the profiler shows this op cluster as blades/sample inside
+        # whatever span dispatched the round (obs/trace.py).
+        with jax.named_scope("blades/sample"):
+            return sample_client_batches(
+                k_sample, data_x, data_y, lengths, self.batch_size,
+                self.num_batches_per_round,
+            )
 
     def step(
         self,
@@ -282,6 +286,9 @@ class FedRound:
         hooks = self._hooks()
         client_keys = jax.random.split(k_train, num_clients)
 
+        # Phase named_scopes (blades/<phase>): HLO op-name metadata for
+        # the profiler/span correlation — trace-time only, numerics
+        # untouched on every path (tests/test_trace.py pins this).
         if self.packing is not None:
             # Lane-packing (parallel/packed.py): P clients per grouped-
             # kernel vmap lane.  Eligibility (resolve_client_packing)
@@ -289,15 +296,17 @@ class FedRound:
             # PRNG streams replicate the unpacked discipline exactly.
             from blades_tpu.parallel.packed import packed_local_round_batched
 
-            updates, client_opt, losses = packed_local_round_batched(
-                self.task, self.packing.pack, state.server.params,
-                state.client_opt, bx, by, client_keys, malicious,
-            )
+            with jax.named_scope("blades/step"):
+                updates, client_opt, losses = packed_local_round_batched(
+                    self.task, self.packing.pack, state.server.params,
+                    state.client_opt, bx, by, client_keys, malicious,
+                )
         else:
-            updates, client_opt, losses = self.task.local_round_batched(
-                state.server.params, state.client_opt, bx, by, client_keys,
-                malicious, *hooks,
-            )
+            with jax.named_scope("blades/step"):
+                updates, client_opt, losses = self.task.local_round_batched(
+                    state.server.params, state.client_opt, bx, by,
+                    client_keys, malicious, *hooks,
+                )
         # Drop ghost (padding) lanes before anything consumes the matrix.
         k = self.num_clients
         if k is not None and k < updates.shape[0]:
@@ -323,9 +332,10 @@ class FedRound:
                 # rebuilt.  Identity codec: the wire IS f32 (scales is
                 # None), so the round falls through to the standard
                 # path below, bit-identical to agg_domain="f32".
-                q, wire_scales, residual = self.codec.decode_deferred(
-                    updates, residual, codec_key
-                )
+                with jax.named_scope("blades/encode"):
+                    q, wire_scales, residual = self.codec.decode_deferred(
+                        updates, residual, codec_key
+                    )
                 if wire_scales is None:
                     updates = q
                 else:
@@ -334,9 +344,10 @@ class FedRound:
                         losses, malicious, k_adv, k_agg,
                     )
             else:
-                updates, residual = self.codec.encode_decode(
-                    updates, residual, codec_key
-                )
+                with jax.named_scope("blades/encode"):
+                    updates, residual = self.codec.encode_decode(
+                        updates, residual, codec_key
+                    )
         # Chaos layer (blades_tpu/faults): dropout / stragglers / lane
         # corruption, realized deterministically from (fault seed, round).
         # Runs at the point the updates "arrive at the server" — before
@@ -349,9 +360,10 @@ class FedRound:
         participation = straggled = None
         stale = getattr(state, "stale", None)
         if self.faults is not None:
-            updates, stale, participation, straggled, _corrupted = (
-                self.faults.inject(updates, stale, state.server.round)
-            )
+            with jax.named_scope("blades/faults"):
+                updates, stale, participation, straggled, _corrupted = (
+                    self.faults.inject(updates, stale, state.server.round)
+                )
         healthy = None
         if self.health_check:
             from blades_tpu.core.health import sanitize_updates
@@ -368,26 +380,30 @@ class FedRound:
         updates = self.apply_dp(updates, k_dp)
 
         if self.adversary is not None and hasattr(self.adversary, "on_updates_ready"):
-            updates = self.adversary.on_updates_ready(
-                updates, malicious, k_adv,
-                aggregator=self.server.aggregator,
-                global_params=state.server.params,
-            )
+            with jax.named_scope("blades/forge"):
+                updates = self.adversary.on_updates_ready(
+                    updates, malicious, k_adv,
+                    aggregator=self.server.aggregator,
+                    global_params=state.server.params,
+                )
 
         trusted_update = self.compute_trusted_update(
             state.server.params, jax.random.fold_in(k_agg, 1)
         )
         diag = None
-        if self.forensics:
-            server, agg, diag = self.server.step_diag(
-                state.server, updates, key=k_agg, trusted_update=trusted_update,
-                participation=participation,
-            )
-        else:
-            server, agg = self.server.step(
-                state.server, updates, key=k_agg, trusted_update=trusted_update,
-                participation=participation,
-            )
+        with jax.named_scope("blades/aggregate"):
+            if self.forensics:
+                server, agg, diag = self.server.step_diag(
+                    state.server, updates, key=k_agg,
+                    trusted_update=trusted_update,
+                    participation=participation,
+                )
+            else:
+                server, agg = self.server.step(
+                    state.server, updates, key=k_agg,
+                    trusted_update=trusted_update,
+                    participation=participation,
+                )
         benign = (~malicious).astype(jnp.float32)
         if participation is not None:
             # Loss and norm summaries cover the lanes that reported: a
@@ -475,23 +491,26 @@ class FedRound:
         ):
             from blades_tpu.comm.codecs import dequantize
 
-            dec = dequantize(q, scales)  # blades-lint: disable=streamed-pass-discipline — sanctioned forge materialization: the adversary reads the FULL quantized-domain geometry (strongest-adversary convention); the single decode is counted in dequant_rows
-            dec = self.adversary.on_updates_ready(
-                dec, malicious, k_adv,
-                aggregator=self.server.aggregator,
-                global_params=state.server.params,
-            )
-            q, scales = self.codec.requantize_rows(dec, q, scales, malicious)
+            with jax.named_scope("blades/forge"):
+                dec = dequantize(q, scales)  # blades-lint: disable=streamed-pass-discipline — sanctioned forge materialization: the adversary reads the FULL quantized-domain geometry (strongest-adversary convention); the single decode is counted in dequant_rows
+                dec = self.adversary.on_updates_ready(
+                    dec, malicious, k_adv,
+                    aggregator=self.server.aggregator,
+                    global_params=state.server.params,
+                )
+                q, scales = self.codec.requantize_rows(dec, q, scales,
+                                                       malicious)
             dequant_extra = q.shape[0]
         trusted_update = self.compute_trusted_update(
             state.server.params, jax.random.fold_in(k_agg, 1)
         )
         recorder = PassRecorder()
-        server, agg, sq = self.server.step_wire(
-            state.server, q, scales, key=k_agg,
-            trusted_update=trusted_update, d_chunk=self.agg_d_chunk,
-            recorder=recorder,
-        )
+        with jax.named_scope("blades/aggregate"):
+            server, agg, sq = self.server.step_wire(
+                state.server, q, scales, key=k_agg,
+                trusted_update=trusted_update, d_chunk=self.agg_d_chunk,
+                recorder=recorder,
+            )
         benign = (~malicious).astype(jnp.float32)
         train_loss = (losses * benign).sum() / jnp.maximum(benign.sum(), 1.0)
         metrics = {
@@ -632,7 +651,8 @@ class FedRound:
         def one_client(cx, cy, m):
             return self.task.evaluate(state.server.params, cx, cy, m)
 
-        per_client = jax.vmap(one_client)(test_x, test_y, mask)
+        with jax.named_scope("blades/eval"):
+            per_client = jax.vmap(one_client)(test_x, test_y, mask)
         total = jnp.maximum(per_client["count"].sum(), 1.0)
         return {
             "test_loss": per_client["ce_sum"].sum() / total,

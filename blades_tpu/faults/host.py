@@ -15,6 +15,10 @@ the Tune-trial analogue of preemptible-VM reality:
 - :class:`PreemptionHook`: a test hook that raises
   :class:`SimulatedPreemption` mid-trial, exercising kill-and-resume
   end-to-end without an actual SIGKILL.
+- :func:`atomic_write_json`: the file-level analogue of
+  :func:`atomic_checkpoint` for single-file artifacts (the flight
+  recorder's ``flightrec.json``, the span tracer's Chrome trace export):
+  tmp + fsync + one ``os.replace``, so a reader never sees a torn file.
 """
 
 from __future__ import annotations
@@ -101,6 +105,28 @@ def _fsync_dir(path: Path) -> None:
         pass
     finally:
         os.close(fd)
+
+
+def atomic_write_json(obj, final_path) -> str:
+    """Write ``obj`` as JSON to ``final_path`` atomically (tmp + fsync +
+    ``os.replace``).  A SIGKILL at any point leaves either the previous
+    complete file (possibly plus an orphaned ``.tmp`` the next write
+    overwrites) or the new complete file — never a torn one.  ``NaN`` /
+    ``Inf`` floats are serialized in Python's JSON dialect (``NaN``,
+    ``Infinity``) on purpose: a flight-recorder dump TRIGGERED by a NaN
+    aggregate must be able to record it.  Returns the published path."""
+    import json
+
+    final_path = Path(final_path)
+    final_path.parent.mkdir(parents=True, exist_ok=True)
+    tmp = final_path.with_name(final_path.name + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=2, default=str)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, final_path)
+    _fsync_dir(final_path.parent)
+    return str(final_path)
 
 
 def atomic_checkpoint(save_fn: Callable[[str], object], final_dir) -> None:

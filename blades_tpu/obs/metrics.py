@@ -75,9 +75,11 @@ class JsonlSink(Sink):
 # The CSV column set: every scalar field of the round-record schema, in
 # schema order.  Fixed up front — NOT inferred from the first record —
 # because eval metrics (test_loss/test_acc) first appear mid-run, after
-# the header is already on disk; CSV has no schema evolution.
+# the header is already on disk; CSV has no schema evolution.  Nested
+# containers (timers, lane_forensics, watchdog_events) stay out.
 _CSV_COLUMNS = [
-    name for name, (types, _) in ROUND_RECORD_FIELDS.items() if dict not in types
+    name for name, (types, _) in ROUND_RECORD_FIELDS.items()
+    if dict not in types and list not in types
 ]
 
 

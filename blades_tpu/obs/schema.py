@@ -119,6 +119,12 @@ ROUND_RECORD_FIELDS: Dict[str, Tuple[tuple, bool]] = {
     "autotune_cache_hit": ((bool,), False),
     "autotune_timed": ((bool,), False),
     "autotune_candidates": ((int,), False),
+    # Anomaly watchdog (obs/watchdog.py): host-side rule evaluations
+    # over this row — a list of event dicts (rule, kind, field, round,
+    # value, limit, message).  Present only on rounds where an armed
+    # watchdog fired; list-typed, so the CSV sink skips it like the
+    # nested dicts.
+    "watchdog_events": ((list,), False),
     # defense forensics (obs/forensics.py)
     "byz_precision": (_NUM, False),
     "byz_recall": (_NUM, False),
@@ -179,6 +185,11 @@ def validate_record(record: Any) -> Dict[str, Any]:
         for phase, stats in timers.items():
             if not isinstance(stats, dict):
                 problems.append(f"timers[{phase!r}] must be a dict")
+    events = record.get("watchdog_events")
+    if isinstance(events, list):
+        for i, ev in enumerate(events):
+            if not isinstance(ev, dict):
+                problems.append(f"watchdog_events[{i}] must be a dict")
     if problems:
         raise SchemaError("; ".join(problems))
     return record

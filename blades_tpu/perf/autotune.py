@@ -522,7 +522,7 @@ class PlanCache:
             "key": dict(key),
             "plan": plan.as_dict(),
             "provenance": dict(provenance or {}),
-            "created_unix": time.time(),
+            "created_unix": time.time(),  # blades-lint: disable=trace-discipline — wall-clock cache metadata stamp, not a duration measurement
         }
         path = self._path(key)
         tmp = path.with_name(path.name + ".tmp")

@@ -27,6 +27,27 @@ def main(argv=None) -> int:
                         help="capture a jax profiler trace into DIR "
                         "(the reference's --trace flag is dead code; this "
                         "one works, on both subcommands)")
+    common.add_argument("--trace-dir", default=None, metavar="DIR",
+                        help="span tracing (obs/trace.py): export each "
+                        "trial's host span tree (trial -> round -> phase, "
+                        "round provenance stamped as args) as "
+                        "Chrome/Perfetto trace JSON into DIR; composes "
+                        "with --trace — armed spans annotate the profiler "
+                        "capture so device work nests inside host spans")
+    common.add_argument("--watchdog", action="store_true",
+                        help="arm the anomaly watchdog (obs/watchdog.py): "
+                        "schema-driven rules (NaN aggregate, update-norm "
+                        "spike, detection-FPR collapse, round-time "
+                        "regression) over the already-fetched rows; "
+                        "events land in metrics rows as watchdog_events "
+                        "and trigger the flight-recorder dump")
+    common.add_argument("--flightrec-rounds", type=int, default=16,
+                        metavar="K",
+                        help="flight recorder (obs/flightrec.py): ring of "
+                        "the last K round digests per trial, dumped "
+                        "atomically to <trial>/flightrec.json on NaN "
+                        "aggregate / crash / preemption (replay with "
+                        "python -m tools.replay_round); 0 disables")
     common.add_argument("--metrics-csv", action="store_true",
                         help="also write <trial>/metrics.csv next to the "
                         "canonical metrics.jsonl stream")
@@ -165,6 +186,9 @@ def main(argv=None) -> int:
                 compile_cache_dir=args.compile_cache,
                 autotune=args.autotune,
                 plan_cache_dir=args.plan_cache_dir,
+                trace_dir=args.trace_dir,
+                watchdog=args.watchdog,
+                flightrec_rounds=args.flightrec_rounds,
             )
 
     else:
@@ -193,12 +217,17 @@ def main(argv=None) -> int:
                 compile_cache_dir=args.compile_cache,
                 autotune=args.autotune,
                 plan_cache_dir=args.plan_cache_dir,
+                trace_dir=args.trace_dir,
+                watchdog=args.watchdog,
+                flightrec_rounds=args.flightrec_rounds,
             )
 
     # --trace wraps EITHER subcommand (the run subcommand used to silently
     # ignore it — a one-off run is exactly when you want a profile).
+    # --trace-dir composes: armed span annotations land inside this
+    # profiler capture.
     if args.trace:
-        from blades_tpu.utils.profiling import trace
+        from blades_tpu.obs.trace import trace
 
         with trace(args.trace):
             summaries = _run()

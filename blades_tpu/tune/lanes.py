@@ -74,6 +74,7 @@ def run_lanes(
     program_key=None,
     metrics_every: int = 1,
     donate: bool = True,
+    tracer=None,
 ) -> List[List[Dict]]:
     """Run one trial per lane-override dict as vmapped lanes of a single
     program.
@@ -98,6 +99,10 @@ def run_lanes(
         donate: donate the lane states into each round dispatch (the
             L-times-stacked client opt states are the group's largest
             buffers); the pre-round states object is consumed.
+        tracer: optional :class:`blades_tpu.obs.trace.Tracer` — round
+            dispatches, evals and metric fetches become spans of the
+            caller's tree (armed tracers additionally correlate device
+            work via jax profiler annotations).
 
     Returns:
         Per lane, the list of per-round result dicts (Tune's
@@ -105,7 +110,10 @@ def run_lanes(
     """
     from blades_tpu.adversaries import make_malicious_mask
     from blades_tpu.data import DatasetCatalog
+    from blades_tpu.obs.trace import Tracer
 
+    if tracer is None:
+        tracer = Tracer(record=False)  # aggregation-only, near-zero cost
     L = len(lane_overrides)
     keys_set = {frozenset(o.keys()) for o in lane_overrides}
     if len(keys_set) != 1:
@@ -282,7 +290,8 @@ def run_lanes(
         nonlocal last_eval
         if not pending:
             return
-        fetched = jax.device_get([(m, e) for _, m, e in pending])
+        with tracer.span("fetch", rows=len(pending)):
+            fetched = jax.device_get([(m, e) for _, m, e in pending])
         for (r, _, _), (metrics, ev) in zip(pending, fetched):
             if ev is not None:
                 last_eval = [
@@ -307,9 +316,13 @@ def run_lanes(
 
     for r in range(1, max_rounds + 1):
         round_keys, carry = jnp.moveaxis(jax.vmap(jax.random.split)(carry), 1, 0)
-        states, metrics = step(states, x, y, ln, mal, round_keys, sc)
-        ev = (evaluate(states, tx, ty, tln, sc)
-              if interval and r % interval == 0 else None)
+        # The first dispatch pays XLA compilation — same phase split as
+        # the sequential driver, so lane-group traces read the same way.
+        with tracer.span("round" if r > 1 else "compile", step=r,
+                         lanes=L):
+            states, metrics = step(states, x, y, ln, mal, round_keys, sc)
+            ev = (evaluate(states, tx, ty, tln, sc)
+                  if interval and r % interval == 0 else None)
         pending.append((r, metrics, ev))
         if len(pending) >= max(1, metrics_every):
             flush()

@@ -436,6 +436,35 @@ def _prune_checkpoints(
         shutil.rmtree(p, ignore_errors=True)
 
 
+def _resolve_watchdog(watchdog):
+    """Normalize the ``watchdog`` request to a rule tuple (or None):
+    ``True``/``"on"`` arms the default rule set; a ``Watchdog`` instance
+    or a sequence of :class:`~blades_tpu.obs.watchdog.WatchdogRule`
+    supplies custom rules.  Each trial gets its OWN evaluator (rolling
+    state is per trial)."""
+    if not watchdog:
+        return None
+    from blades_tpu.obs.watchdog import Watchdog, default_rules
+
+    if watchdog is True or watchdog == "on":
+        return default_rules()
+    if isinstance(watchdog, Watchdog):
+        return watchdog.rules
+    return tuple(watchdog)
+
+
+# Row fields mirrored onto the dispatch span as provenance args, so a
+# trace viewer shows the autotuner / fusion / codec decisions inline
+# with the time they explain (ISSUE 12).
+_TRACE_ROW_ATTRS = (
+    "training_iteration", "plan_id", "hbm_passes", "hbm_passes_unfused",
+    "agg_domain", "agg_domain_bits", "comm_bytes_up", "codec_bits",
+    "comm_compression_ratio", "pack_factor", "packed_lanes",
+    "elided_lanes", "compile_cache_hits", "compile_cache_misses",
+    "dequant_rows", "num_participating", "num_dropped", "num_straggled",
+)
+
+
 def _run_lane_group(
     spec_run: str,
     trials: List[Dict],
@@ -447,6 +476,9 @@ def _run_lane_group(
     metrics_csv: bool = False,
     strict_metrics: bool = True,
     metrics_every: int = 1,
+    trace_dir: Optional[str] = None,
+    wd_rules=None,
+    flightrec_rounds: int = 0,
 ) -> Dict[int, Dict]:
     """Run one lane group as a vmapped program; write each member trial's
     ``result.json``/``params.json``/metrics streams exactly as the
@@ -456,6 +488,9 @@ def _run_lane_group(
     post-hoc burst, not a liveness signal.)"""
     from blades_tpu.algorithms import get_algorithm_class
     from blades_tpu.obs import CsvSink, JsonlSink, MetricsLogger
+    from blades_tpu.obs.flightrec import FlightRecorder
+    from blades_tpu.obs.trace import Timers
+    from blades_tpu.obs.watchdog import Watchdog
     from blades_tpu.tune.lanes import run_lanes
 
     sig_cfg = None
@@ -487,15 +522,25 @@ def _run_lane_group(
     from blades_tpu.perf import cache_stats, fingerprint
 
     cache_before = cache_stats()
-    t0 = time.perf_counter()
+    # Span tracing (obs/trace.py): the group's round dispatches become
+    # spans of ONE tree, exported per group when --trace-dir is set.
+    tracer = Timers(record=bool(trace_dir))
+    gspan = tracer.start("lane_group", experiment=exp_name,
+                         trials=len(group), rounds=max_rounds)
     # program_key: the group's SHARED static config (the lane signature
     # with the per-lane knobs already sentinel-ed out) — identical groups
     # across experiments/sweeps reuse one compiled lane program.
     results = run_lanes(builder, overrides, max_rounds,
                         program_key=(spec_run.upper(), fingerprint(sig_cfg),
                                      len(overrides)),
-                        metrics_every=metrics_every)
-    wall = time.perf_counter() - t0
+                        metrics_every=metrics_every, tracer=tracer)
+    tracer.finish(gspan)
+    wall = gspan.duration
+    if trace_dir:
+        tdir_trace = Path(trace_dir).expanduser()
+        tracer.export(tdir_trace / (f"{exp_name}_lanes_"
+                                    f"{group[0]:05d}-{group[-1]:05d}"
+                                    ".trace.json"))
     cache_after = cache_stats()
     cache_delta = {
         "hits": cache_after["hits"] - cache_before["hits"],
@@ -514,13 +559,40 @@ def _run_lane_group(
                                  strict=strict_metrics)]
         if metrics_csv:
             sinks.append(CsvSink(tdir / "metrics.csv"))
+        # Watchdog + flight recorder run POST-hoc here (the vmapped
+        # program returns all rows after the group finishes), with
+        # fresh per-trial rolling state — the same rules and dump
+        # triggers as the sequential path, minus the mid-run liveness.
+        wd = Watchdog(wd_rules) if wd_rules is not None else None
+        # A stale dump from a previous run in the same storage path
+        # describes a PREVIOUS divergence — postmortem poison next to
+        # this run's fresh artifacts (same contract as the sequential
+        # path's fresh-run cleanup; lane groups never run under resume).
+        (tdir / "flightrec.json").unlink(missing_ok=True)
+        flightrec = (FlightRecorder(
+            tdir / "flightrec.json", capacity=flightrec_rounds,
+            experiment=exp_name, trial=tname, algo=spec_run,
+            config=trials[i], max_rounds=max_rounds)
+            if flightrec_rounds else None)
         with open(tdir / "result.json", "w") as f, MetricsLogger(
             sinks, base={"experiment": exp_name, "trial": tname},
         ) as logger:
-            for r in rows:
-                r = _jsonable(r)
-                f.write(json.dumps({**r, "trial": tname}) + "\n")
-                logger.log(r)
+            for row in rows:
+                row = _jsonable(row)
+                events = wd.observe(row) if wd is not None else []
+                if events:
+                    row["watchdog_events"] = [e.as_dict() for e in events]
+                f.write(json.dumps({**row, "trial": tname}) + "\n")
+                logger.log(row)
+                if flightrec is not None:
+                    flightrec.record(row)
+                    trig = flightrec.check(row)
+                    if trig is None and events:
+                        trig = {"kind": "watchdog",
+                                "rules": [e.rule for e in events],
+                                "round": row.get("training_iteration")}
+                    if trig is not None:
+                        flightrec.dump(trig)
         best = max((r.get("test_acc", 0.0) for r in rows), default=0.0)
         final = {k: rows[-1][k] for k in ("test_loss", "test_acc",
                                           "test_acc_top3")
@@ -589,8 +661,41 @@ def run_experiments(
     compile_cache_dir: Optional[str] = None,
     autotune=None,
     plan_cache_dir: Optional[str] = None,
+    trace_dir: Optional[str] = None,
+    watchdog=False,
+    flightrec_rounds: int = 16,
 ) -> List[Dict]:
     """Run every trial of every experiment; returns summaries.
+
+    **Observability layer** (ISSUE 12, :mod:`blades_tpu.obs`):
+
+    - ``trace_dir`` (the CLI's ``--trace-dir``): arm the span tracer —
+      every trial records a host-side span tree (trial -> round /
+      compile / checkpoint -> training_step / evaluate) with round
+      provenance (plan_id, hbm_passes, agg_domain, comm_bytes_up, ...)
+      stamped on the dispatch spans, exported atomically as
+      Chrome/Perfetto trace JSON to ``<trace_dir>/<trial>.trace.json``
+      (lane groups export one tree per group).  Composes with the
+      ``--trace`` jax-profiler hook: armed spans enter
+      ``TraceAnnotation``/``StepTraceAnnotation``, so device work lands
+      inside the right host span in the profiler capture.  Off
+      (default) the rows/aggregates are bit-identical to a pre-span
+      build — the tracer degenerates to the old phase accumulator.
+    - ``watchdog`` (the CLI's ``--watchdog``): arm the anomaly watchdog
+      (:mod:`blades_tpu.obs.watchdog`) — schema-driven rules (NaN
+      aggregate/loss, update-norm spike vs rolling median,
+      detection-FPR collapse, round-wall-time regression) evaluated
+      host-side on the already-fetched rows, zero extra device syncs.
+      Firing rules land in the row as ``watchdog_events`` and trigger
+      the flight-recorder dump.  Kill-and-resume rebuilds the rolling
+      windows from the truncated on-disk rows, so a restored trial
+      replays the same rule decisions.
+    - ``flightrec_rounds`` (default 16, 0 disables): each trial keeps a
+      bounded ring of its last K row digests and dumps it atomically to
+      ``<trial>/flightrec.json`` on a non-finite aggregate, a watchdog
+      event, an uncaught exception, or a (simulated) preemption —
+      ``tools/replay_round.py`` re-executes the recorded round
+      bit-identically from the dump's (config, seed, tick).
 
     **Round-pipeline perf layer** (:mod:`blades_tpu.perf`):
 
@@ -702,15 +807,19 @@ def run_experiments(
     or skipped rounds — is exercised end-to-end without a real SIGKILL.
     """
     from blades_tpu.algorithms import get_algorithm_class
-    from blades_tpu.faults.host import (PreemptionHook, atomic_checkpoint,
-                                        retry_backoff)
+    from blades_tpu.faults.host import (PreemptionHook, SimulatedPreemption,
+                                        atomic_checkpoint, retry_backoff)
     from blades_tpu.obs import CsvSink, JsonlSink, MetricsLogger, StdoutSink
+    from blades_tpu.obs.flightrec import FlightRecorder
+    from blades_tpu.obs.trace import Timers
+    from blades_tpu.obs.watchdog import Watchdog
     from blades_tpu.perf import (cache_stats,
                                  enable_persistent_compilation_cache,
                                  flush_rows)
-    from blades_tpu.utils.timers import Timers
 
     enable_persistent_compilation_cache(compile_cache_dir)
+    wd_rules = _resolve_watchdog(watchdog)
+    flightrec_rounds = int(flightrec_rounds or 0)
 
     def _apply_autotune(config) -> bool:
         """Apply the sweep-level autotune request to a trial config
@@ -757,6 +866,8 @@ def run_experiments(
                         root, verbose, metrics_csv=metrics_csv,
                         strict_metrics=strict_metrics,
                         metrics_every=metrics_every,
+                        trace_dir=trace_dir, wd_rules=wd_rules,
+                        flightrec_rounds=flightrec_rounds,
                     ))
                 except Exception as exc:
                     # LOUD fallback: a lane-group failure means the
@@ -791,7 +902,11 @@ def run_experiments(
 
                 for p in tdir.glob("ckpt_*"):
                     shutil.rmtree(p, ignore_errors=True)
-                for p in (tdir / "metrics.jsonl", tdir / "metrics.csv"):
+                for p in (tdir / "metrics.jsonl", tdir / "metrics.csv",
+                          # A stale flight-recorder dump describes a
+                          # PREVIOUS run's divergence — postmortem
+                          # poison for this one.
+                          tdir / "flightrec.json"):
                     p.unlink(missing_ok=True)
             prior = _read_results(tdir / "result.json") if resume else []
             best_acc = max((r.get("test_acc", 0.0) for r in prior), default=0.0)
@@ -853,14 +968,35 @@ def run_experiments(
                        if resumed_from else "")
                 print(f"== trial {tname}: {max_rounds} rounds{tag} ==",
                       flush=True)
-            t0 = time.perf_counter()
+            timers = Timers(record=bool(trace_dir))
+            if trace_dir:
+                # One span tree per trial: the algorithm's phase timers
+                # (training_step / evaluate) nest inside this tracer's
+                # trial/round spans, and the tree exports to trace_dir.
+                algo.adopt_tracer(timers)
+            trial_span = timers.start("trial", experiment=exp_name,
+                                      trial=tname)
             start_round = algo.iteration
             ckpt_scores: Dict[str, float] = {}
             failures = 0
             failed_error = None
-            timers = Timers()
             compiled = False
             last_row: Dict = {}  # survives the attempt loop (comm summary)
+            # Anomaly watchdog + flight recorder (obs subsystem): fresh
+            # per-trial state; a resumed trial warms its rolling windows
+            # from the truncated on-disk rows so rule decisions replay.
+            wd = Watchdog(wd_rules) if wd_rules is not None else None
+            flightrec = (FlightRecorder(
+                tdir / "flightrec.json", capacity=flightrec_rounds,
+                experiment=exp_name, trial=tname, algo=spec["run"],
+                config=trial_cfg, max_rounds=max_rounds)
+                if flightrec_rounds else None)
+            if resumed_from and (wd is not None or flightrec is not None):
+                surviving = _read_results(tdir / "result.json")
+                if wd is not None:
+                    wd.warm(surviving)
+                if flightrec is not None:
+                    flightrec.rewind(surviving)
             while True:
                 mode = "a" if (resumed_from or failures) else "w"
                 logger = None
@@ -903,8 +1039,36 @@ def run_experiments(
                             for result in rows:
                                 result["trial"] = tname
                                 row = _jsonable(result)
+                                events = (wd.observe(row)
+                                          if wd is not None else [])
+                                if events:
+                                    row["watchdog_events"] = [
+                                        e.as_dict() for e in events]
                                 f.write(json.dumps(row) + "\n")
                                 logger.log(row)
+                                if flightrec is not None:
+                                    flightrec.record(row)
+                                    trig = flightrec.check(row)
+                                    if trig is None and events:
+                                        trig = {
+                                            "kind": "watchdog",
+                                            "rules": [e.rule
+                                                      for e in events],
+                                            "round": row.get(
+                                                "training_iteration"),
+                                        }
+                                    if trig is not None:
+                                        flightrec.dump(trig)
+                                if trace_dir:
+                                    # Round provenance onto the span
+                                    # that dispatched this row (the
+                                    # first dispatch is the "compile"
+                                    # span).
+                                    timers.stamp_latest_of(
+                                        ("round", "compile"),
+                                        {k: row[k]
+                                         for k in _TRACE_ROW_ATTRS
+                                         if k in row})
                                 best_acc = max(best_acc,
                                                result.get("test_acc", 0.0))
                                 last_row = result
@@ -921,8 +1085,12 @@ def run_experiments(
                         while algo.iteration < max_rounds:
                             # The first dispatch pays XLA compilation; split
                             # it from steady-state rounds so neither timing
-                            # pollutes the other.
-                            with timers.time("round" if compiled else "compile"):
+                            # pollutes the other.  `step` puts the armed
+                            # span under a StepTraceAnnotation, so device
+                            # work correlates in a profiler capture.
+                            with timers.time("round" if compiled
+                                             else "compile",
+                                             step=algo.iteration):
                                 if per_round_rows:
                                     rows = algo.train_rows(per_round=True)
                                 elif defer:
@@ -979,6 +1147,18 @@ def run_experiments(
                     with open(tdir / "error.txt", "a") as ef:
                         ef.write(f"attempt {failures}: {exc!r}\n")
                         ef.write(traceback.format_exc() + "\n")
+                    if flightrec is not None:
+                        # The postmortem artifact a relay-box failure
+                        # used to leave nothing of: the last K rounds'
+                        # digests, durable before any retry/abort.
+                        flightrec.dump({
+                            "kind": ("preemption"
+                                     if isinstance(exc,
+                                                   SimulatedPreemption)
+                                     else "exception"),
+                            "error": repr(exc),
+                            "round": algo.iteration,
+                        })
                     # SchemaError is deterministic metrics-schema drift, not
                     # a transient fault: every retry would re-pay the compile
                     # and fail identically on its first record.  Fail fast
@@ -1014,6 +1194,8 @@ def run_experiments(
                         # prevent.
                         _pin_checkpoint_plan(config, tdir)
                     algo = config.build()
+                    if trace_dir:
+                        algo.adopt_tracer(timers)
                     compiled = False  # fresh build recompiles
                     ckpt = _latest_checkpoint(tdir)
                     if ckpt is not None:
@@ -1021,6 +1203,17 @@ def run_experiments(
                     _truncate_results(tdir / "result.json", algo.iteration)
                     _truncate_results(tdir / "metrics.jsonl", algo.iteration)
                     _truncate_csv(tdir / "metrics.csv", algo.iteration)
+                    if wd is not None or flightrec is not None:
+                        # Replay the surviving rows into the rolling
+                        # windows / the digest ring: the restarted trial
+                        # sees the same history a straight-through run
+                        # would, and the ring holds no stale ticks from
+                        # the failed attempt.
+                        surviving = _read_results(tdir / "result.json")
+                        if wd is not None:
+                            wd.warm(surviving)
+                        if flightrec is not None:
+                            flightrec.rewind(surviving)
                     if verbose:
                         print(f"   .. retrying {tname} from round "
                               f"{algo.iteration} (failure {failures}/"
@@ -1031,7 +1224,15 @@ def run_experiments(
             if checkpoint_at_end and failed_error is None:
                 with timers.time("checkpoint"):
                     atomic_checkpoint(algo.save_checkpoint, tdir / "ckpt_final")
-            wall = time.perf_counter() - t0
+            timers.finish(trial_span)
+            wall = trial_span.duration
+            if trace_dir:
+                # Per-trial Chrome/Perfetto trace, written atomically
+                # (load in chrome://tracing or ui.perfetto.dev).
+                timers.stamp_latest("trial", {"rounds": algo.iteration,
+                                              "failures": failures})
+                timers.export(Path(trace_dir).expanduser()
+                              / f"{tname}.trace.json")
             new_rounds = algo.iteration - start_round
             # Sweep-level phase timings (satellite: compile / round / eval /
             # checkpoint): eval runs INSIDE algo.train(), so its phase
@@ -1073,6 +1274,18 @@ def run_experiments(
                 # pack_factor 1 plus the reason, so operators can tell
                 # packed from unpacked runs without reading logs.
                 summary["packing"] = packing
+            if wd is not None and wd.events:
+                # Anomaly-watchdog digest: which rules fired, how often
+                # (the full event dicts ride the rows' watchdog_events).
+                summary["watchdog"] = {
+                    "events": len(wd.events),
+                    "rules": sorted({e.rule for e in wd.events}),
+                }
+            if flightrec is not None and flightrec.dumps:
+                summary["flightrec"] = {
+                    "dumps": flightrec.dumps,
+                    "path": str(tdir / "flightrec.json"),
+                }
             if scan_w > 1:
                 summary["scan_window"] = scan_w
             plan_summary = getattr(algo, "plan_summary", None)

@@ -1,40 +1,13 @@
-"""Profiling / tracing — the observability the reference stubs
-(ref: blades/train.py:343-346's dead ``--trace`` flag; SURVEY.md §5).
+"""Back-compat shim: profiling/tracing moved into the span layer.
 
-- :func:`trace` — context manager around ``jax.profiler`` producing a
-  TensorBoard-loadable trace directory.
-- :func:`annotate` — named region inside a trace (host-side).
-- :func:`xla_dump_flags` — the XLA_FLAGS string to dump HLO for a run
-  (must be set before the first compilation).
-"""
+:func:`~blades_tpu.obs.trace.trace` (jax profiler capture),
+:func:`~blades_tpu.obs.trace.annotate` (named trace region) and
+:func:`~blades_tpu.obs.trace.xla_dump_flags` now live in
+:mod:`blades_tpu.obs.trace`, next to the span tracer whose annotations
+they compose with.  Import from there in new code."""
 
-from __future__ import annotations
-
-from contextlib import contextmanager
-from typing import Iterator
-
-
-@contextmanager
-def trace(log_dir: str) -> Iterator[None]:
-    """Capture a jax profiler trace (device + host) into ``log_dir``."""
-    import jax.profiler
-
-    jax.profiler.start_trace(log_dir)
-    try:
-        yield
-    finally:
-        jax.profiler.stop_trace()
-
-
-@contextmanager
-def annotate(name: str) -> Iterator[None]:
-    """Named sub-region, visible in the trace viewer."""
-    import jax.profiler
-
-    with jax.profiler.TraceAnnotation(name):
-        yield
-
-
-def xla_dump_flags(dump_dir: str) -> str:
-    """XLA_FLAGS value that dumps optimised HLO text to ``dump_dir``."""
-    return f"--xla_dump_to={dump_dir} --xla_dump_hlo_as_text"
+from blades_tpu.obs.trace import (  # noqa: F401
+    annotate,
+    trace,
+    xla_dump_flags,
+)

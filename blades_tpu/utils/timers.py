@@ -1,37 +1,10 @@
-"""Per-phase wall-clock timers — the observability the reference stubs out
-(ref: blades/algorithms/fedavg/fedavg.py:152 creates ``_timers`` and never
-populates them).  Used with explicit ``block_until_ready`` at the call
-sites so async dispatch doesn't fake sub-ms rounds."""
+"""Back-compat shim: the PR-1 phase timers are now spans.
 
-from __future__ import annotations
+``Timers`` lives in :mod:`blades_tpu.obs.trace` — an un-armed
+:class:`~blades_tpu.obs.trace.Tracer` IS the old accumulator (same
+``time(name)`` context manager, same ``summary()`` shape), and an armed
+one additionally records the span tree the trace exporter and the jax
+profiler annotations hang off.  Import from the span layer directly in
+new code; this module exists so PR-1-era call sites keep working."""
 
-import time
-from collections import defaultdict
-from contextlib import contextmanager
-from typing import Dict
-
-
-class Timers:
-    def __init__(self):
-        self._totals: Dict[str, float] = defaultdict(float)
-        self._counts: Dict[str, int] = defaultdict(int)
-
-    @contextmanager
-    def time(self, name: str):
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            self._totals[name] += time.perf_counter() - t0
-            self._counts[name] += 1
-
-    def mean(self, name: str) -> float:
-        c = self._counts[name]
-        return self._totals[name] / c if c else 0.0
-
-    def summary(self) -> Dict[str, Dict[str, float]]:
-        return {
-            k: {"mean_s": self.mean(k), "total_s": self._totals[k],
-                "count": self._counts[k]}
-            for k in self._totals
-        }
+from blades_tpu.obs.trace import Timers  # noqa: F401

@@ -1,0 +1,25 @@
+"""Seeded trace-discipline violations (never imported; excluded from the
+default tree scan).  Every raw clock call here must be caught."""
+
+import time
+from time import monotonic as mono
+from time import perf_counter
+
+
+def raw_wall_clock():
+    # time.time() — a wall-clock read outside the trace layer.
+    return time.time()
+
+
+def raw_duration():
+    t0 = perf_counter()          # from-import form
+    busy = sum(range(10))
+    return perf_counter() - t0, busy
+
+
+def raw_monotonic_alias():
+    return mono()                # aliased from-import form
+
+
+def raw_ns_variant():
+    return time.perf_counter_ns()

@@ -43,6 +43,7 @@ from tools.lint.passes.purity import PurityPass  # noqa: E402
 from tools.lint.passes.schema_drift import SchemaDriftPass  # noqa: E402
 from tools.lint.passes.slow_markers import audit_path  # noqa: E402
 from tools.lint.passes.static_args import StaticArgsPass  # noqa: E402
+from tools.lint.passes.trace_discipline import TraceDisciplinePass  # noqa: E402
 from tools.lint.core import LintContext  # noqa: E402
 
 FIX = "tests/lint_fixtures"
@@ -159,6 +160,31 @@ def test_pass_discipline_fixtures():
     # + aggregate_wire) produce nothing.
     assert run_fixture([PassDisciplinePass()],
                        "passdiscipline_good.py") == []
+
+
+def test_trace_discipline_fixtures():
+    tp = TraceDisciplinePass(prefixes=[f"{FIX}/tracediscipline_bad.py"])
+    bad = errors_of(run_fixture([tp], "tracediscipline_bad.py"),
+                    "trace-discipline")
+    msgs = "\n".join(f.message for f in bad)
+    assert "time.time()" in msgs
+    assert "perf_counter()" in msgs          # from-import form
+    assert "mono()" in msgs                  # aliased from-import
+    assert "time.perf_counter_ns()" in msgs  # _ns variant
+    assert len(bad) == 5
+    # Clean twin: spans, obs.trace.now(), time.sleep, an injectable
+    # clock REFERENCE, and a pragma'd wall-clock stamp all stay silent.
+    tg = TraceDisciplinePass(prefixes=[f"{FIX}/tracediscipline_good.py"])
+    assert run_fixture([tg], "tracediscipline_good.py") == []
+
+
+def test_trace_discipline_allows_timer_modules():
+    """The span layer itself (and its shims) are the sanctioned homes of
+    raw clock reads — the default-configured pass must skip them while
+    still scanning the rest of blades_tpu/."""
+    findings = errors_of(run_passes(REPO, [TraceDisciplinePass()]),
+                         "trace-discipline")
+    assert findings == [], "\n".join(f.render() for f in findings)
 
 
 def test_slow_markers_fixture(tmp_path):
@@ -321,8 +347,8 @@ def test_cli_lists_all_passes():
     assert len(names) >= 7  # ISSUE 8: at least 6 passes + the folded audit
     for expected in ("use-after-donate", "prng-reuse", "jit-purity",
                      "host-sync", "static-config", "schema-drift",
-                     "streamed-pass-discipline", "slow-markers",
-                     "artifact-stamps"):
+                     "streamed-pass-discipline", "trace-discipline",
+                     "slow-markers", "artifact-stamps"):
         assert expected in names
 
 
@@ -359,11 +385,13 @@ def test_fixture_dir_is_excluded_from_tree_scan():
 
 @pytest.mark.parametrize("seeded", [
     "donation_bad.py", "prng_bad.py", "purity_bad.py", "hostsync_bad.py",
-    "static_bad.py", "schema_stamp_bad.py", "passdiscipline_bad.py"])
+    "static_bad.py", "schema_stamp_bad.py", "passdiscipline_bad.py",
+    "tracediscipline_bad.py"])
 def test_every_seeded_violation_class_is_caught(seeded):
-    """ISSUE 8 acceptance (+ ISSUE 9's pass discipline): donation reuse,
-    key reuse, env-read-in-jit, host sync, unfrozen static config,
-    unregistered metric key, raw-traversal-outside-planner — each
+    """ISSUE 8 acceptance (+ ISSUE 9's pass discipline, ISSUE 12's
+    trace discipline): donation reuse, key reuse, env-read-in-jit, host
+    sync, unfrozen static config, unregistered metric key,
+    raw-traversal-outside-planner, raw-clock-outside-trace-layer — each
     deliberately-seeded class is caught by its pass."""
     passes = [
         DonationPass(), PrngPass(), PurityPass(),
@@ -372,6 +400,7 @@ def test_every_seeded_violation_class_is_caught(seeded):
         SchemaDriftPass(schema_module=f"{FIX}/schema_mod.py",
                         stamp_modules=[f"{FIX}/schema_stamp_bad.py"]),
         PassDisciplinePass(),
+        TraceDisciplinePass(prefixes=[f"{FIX}/tracediscipline_bad.py"]),
     ]
     extra = (["schema_mod.py"] if seeded == "schema_stamp_bad.py" else [])
     findings = run_fixture(passes, seeded, *extra)

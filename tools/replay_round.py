@@ -1,0 +1,162 @@
+"""Replay a flight-recorder dump's round, bit-identically.
+
+Every execution path is deterministic in ``(config, seed)``: the
+training stream is the split chain of ``PRNGKey(seed)`` and the fault
+stream is pure in ``(fault_seed, round)``.  A flight-recorder dump
+(:mod:`blades_tpu.obs.flightrec`) therefore carries everything needed
+to re-execute the failing round in isolation — no model state rides
+the dump.  This CLI rebuilds the trial config from the dump, re-runs
+the trajectory to the recorded tick, and compares the replayed round's
+digest against the recorded one BIT-for-bit (NaN matches NaN; exact
+float equality everywhere else — the replay either reproduces the
+divergence exactly or the determinism contract is broken, which is
+itself the finding).
+
+Usage::
+
+    python -m tools.replay_round <flightrec.json> [--tick N] [--quiet]
+
+``--tick`` defaults to the dump's trigger round (falling back to the
+newest recorded round).  Exit code 0 = every compared field matched
+bit-identically; 1 = mismatch or unusable dump.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import struct
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+
+def _bits(x: float) -> int:
+    return struct.unpack("<Q", struct.pack("<d", float(x)))[0]
+
+
+def bit_equal(a, b) -> bool:
+    """Bit-identical float comparison: NaN == NaN (a NaN-corrupted round
+    must replay as the same NaN), otherwise exact representation
+    equality."""
+    fa, fb = float(a), float(b)
+    if math.isnan(fa) and math.isnan(fb):
+        return True
+    return _bits(fa) == _bits(fb)
+
+
+def replay(dump: dict, tick=None):
+    """Re-run the dump's trajectory to ``tick``; returns
+    ``(replayed row, recorded digest)``.  Raises ``ValueError`` when the
+    dump records nothing usable."""
+    from blades_tpu.algorithms import get_algorithm_class
+
+    rounds = dump.get("rounds") or []
+    by_tick = {r.get("training_iteration"): r for r in rounds
+               if isinstance(r, dict)}
+    if tick is None:
+        trig = dump.get("trigger") or {}
+        tick = trig.get("round") or (dump.get("rng") or {}).get("tick")
+    if tick not in by_tick:
+        raise ValueError(
+            f"tick {tick!r} is not in the dump's recorded window "
+            f"{sorted(by_tick)} — the ring only holds the last "
+            f"{dump.get('capacity')} rounds")
+    recorded = by_tick[tick]
+
+    _, config = get_algorithm_class(dump["algo"], return_config=True)
+    config.update_from_dict(json.loads(json.dumps(dump.get("config", {}))))
+    algo = config.build()
+    row = None
+    while algo.iteration < tick:
+        row = algo.train()
+    if row is None or row.get("training_iteration") != tick:
+        raise ValueError(
+            f"replay stopped at iteration {algo.iteration} "
+            f"(rounds_per_dispatch overshoots tick {tick}?)")
+    return row, recorded
+
+
+def compare(row: dict, recorded: dict):
+    """(matches, mismatches, skipped) over the replay-comparable digest
+    fields present in the recording."""
+    from blades_tpu.obs.flightrec import REPLAY_FIELDS
+
+    matches, mismatches, skipped = [], [], []
+    for field in REPLAY_FIELDS:
+        if field not in recorded:
+            continue
+        want = recorded[field]
+        if not isinstance(want, (int, float)) or isinstance(want, bool):
+            skipped.append(field)
+            continue
+        have = row.get(field)
+        if not isinstance(have, (int, float)) or isinstance(have, bool):
+            mismatches.append((field, want, have))
+        elif bit_equal(want, have):
+            matches.append(field)
+        else:
+            mismatches.append((field, want, have))
+    return matches, mismatches, skipped
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tools.replay_round",
+        description="re-execute a flight-recorded round from (config, "
+                    "seed, tick) and verify the digest bit-identically",
+    )
+    p.add_argument("dump", help="path to a flightrec.json dump")
+    p.add_argument("--tick", type=int, default=None,
+                   help="round to replay (default: the trigger round)")
+    p.add_argument("--quiet", action="store_true")
+    args = p.parse_args(argv)
+
+    from blades_tpu.obs.flightrec import validate_flightrec
+
+    num_rounds, errors = validate_flightrec(args.dump)
+    if errors:
+        for e in errors:
+            print(f"{args.dump}: {e}", file=sys.stderr)
+        return 1
+    with open(args.dump) as f:
+        dump = json.load(f)
+    try:
+        row, recorded = replay(dump, tick=args.tick)
+    except (ValueError, KeyError) as exc:
+        print(f"replay failed: {exc}", file=sys.stderr)
+        return 1
+    matches, mismatches, skipped = compare(row, recorded)
+    tick = recorded.get("training_iteration")
+    if not args.quiet:
+        trig = (dump.get("trigger") or {}).get("kind", "?")
+        print(f"{args.dump}: trial {dump.get('trial')!r}, trigger "
+              f"{trig!r}, replayed round {tick} "
+              f"({num_rounds} recorded round(s) in the ring)")
+        for field in matches:
+            print(f"  {field}: {recorded[field]!r}  == replay  OK")
+        for field, want, have in mismatches:
+            print(f"  {field}: recorded {want!r} != replayed {have!r}  "
+                  "MISMATCH")
+        if skipped:
+            print(f"  (skipped non-scalar fields: {skipped})")
+    if mismatches:
+        print(f"replay DIVERGED on {len(mismatches)} field(s) — the "
+              "determinism contract is broken for this config",
+              file=sys.stderr)
+        return 1
+    if not matches:
+        print("nothing to compare (recorded digest has no replay "
+              "fields)", file=sys.stderr)
+        return 1
+    print(f"replay of round {tick} is bit-identical "
+          f"({len(matches)} field(s))")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,95 @@
+"""Offline artifact validator: metrics streams, flight-recorder dumps,
+span traces.
+
+The PR-1 offline validator (``python -m blades_tpu.obs.schema``) grew
+two artifact classes in ISSUE 12; this CLI is the one front door:
+
+- default: ``metrics.jsonl`` streams against the round-record schema
+  (delegates to :func:`blades_tpu.obs.schema.validate_jsonl`);
+- ``--flightrec``: ``flightrec.json`` dumps
+  (:func:`blades_tpu.obs.flightrec.validate_flightrec`);
+- ``--trace``: Chrome/Perfetto span-trace exports
+  (:func:`blades_tpu.obs.trace.validate_chrome_trace`).
+
+Torn-write tolerance matches the metrics.jsonl contract everywhere: a
+torn final JSONL line (a killed writer) or an unreadable JSON artifact
+is a REPORTED error with a nonzero exit code, never an exception —
+and an orphaned ``*.tmp`` sibling (an atomic write a SIGKILL
+interrupted) is flagged as exactly that, since the published file next
+to it is still the newest complete artifact.
+
+Usage::
+
+    python -m tools.validate_metrics <trial>/metrics.jsonl ...
+    python -m tools.validate_metrics --flightrec <trial>/flightrec.json
+    python -m tools.validate_metrics --trace traces/*.trace.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+
+def _report(path, num_ok: int, what: str, errors) -> int:
+    print(f"{path}: {num_ok} valid {what}, {len(errors)} error(s)")
+    for err in errors:
+        if isinstance(err, tuple):
+            lineno, msg = err
+            print(f"  line {lineno}: {msg}")
+        else:
+            print(f"  {err}")
+    tmp = Path(str(path) + ".tmp")
+    if tmp.exists():
+        print(f"  note: orphaned {tmp.name} alongside (an atomic write "
+              "was interrupted; the published file is the newest "
+              "complete artifact)")
+    return 1 if errors else 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tools.validate_metrics",
+        description="schema-check observability artifacts: metrics.jsonl "
+                    "(default), flight-recorder dumps (--flightrec), "
+                    "span traces (--trace)",
+    )
+    mode = p.add_mutually_exclusive_group()
+    mode.add_argument("--flightrec", action="store_true",
+                      help="validate flightrec.json dump(s)")
+    mode.add_argument("--trace", action="store_true",
+                      help="validate Chrome/Perfetto trace export(s)")
+    p.add_argument("paths", nargs="+")
+    args = p.parse_args(argv)
+
+    rc = 0
+    for path in args.paths:
+        if not Path(path).exists():
+            print(f"{path}: no such file")
+            rc = 1
+            continue
+        if args.flightrec:
+            from blades_tpu.obs.flightrec import validate_flightrec
+
+            num, errors = validate_flightrec(path)
+            rc |= _report(path, num, "recorded round(s)", errors)
+        elif args.trace:
+            from blades_tpu.obs.trace import validate_chrome_trace
+
+            num, errors = validate_chrome_trace(path)
+            rc |= _report(path, num, "span event(s)", errors)
+        else:
+            from blades_tpu.obs.schema import validate_jsonl
+
+            num, errors = validate_jsonl(path)
+            rc |= _report(path, num, "record(s)", errors)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
