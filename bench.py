@@ -21,7 +21,11 @@ Two measured workloads, one JSON line:
    ``BLADES_BENCH_AUTOTUNE``: the same protocol through the full driver
    with default knobs vs a measured default-tier execution plan —
    ``perf/autotune.py`` — reporting the selected plan + provenance,
-   also riding both TPU main and cpu_fallback.)
+   also riding both TPU main and cpu_fallback.  And env-gated
+   ``BLADES_BENCH_ASYNC``: the same protocol under buffered-async
+   execution — ``blades_tpu/arrivals`` — reporting the ingest metric
+   ``updates_per_sec`` under a Poisson arrival process with Lazy
+   free-riders next to ``rounds_per_sec``, on both backends.)
 2. **ResNet-18 @ 768 clients** (the model BASELINE.json actually names):
    768 is the single-chip capacity limit under malicious-lane elision —
    the benign-compacted bf16 update matrix stores 576 rows = 12.9 GB
@@ -834,6 +838,80 @@ def _autotune_block(cpu: bool) -> dict:
             "tuned_speedup": speedup}
 
 
+def _measure_async_cnn(*, num_clients=32, num_byzantine=8, agg_every=16,
+                       rate=0.25, timed_cycles=3,
+                       aggregator="Median") -> dict:
+    """The 32-client CNN protocol under buffered-async execution
+    (blades_tpu/arrivals): a deterministic Poisson arrival process
+    drives continuous update traffic, Lazy free-riders ride the
+    Byzantine quarter, and the server fires a staleness-weighted
+    ``aggregator`` every ``agg_every`` buffered arrivals.  Reports the
+    ingest metric — ``updates_per_sec`` — NEXT TO ``rounds_per_sec``
+    (one "round" = one aggregation cycle), which is the number that
+    matters when clients arrive on their own clocks instead of cohorts.
+    """
+    from blades_tpu.adversaries import get_adversary, make_malicious_mask
+    from blades_tpu.arrivals import AsyncEngine, AsyncSpec
+    from blades_tpu.core import FedRound, Server, TaskSpec
+
+    task = TaskSpec(model="cnn", input_shape=(32, 32, 3), num_classes=10,
+                    lr=0.1).build()
+    server = Server.from_config(aggregator=aggregator, lr=0.5)
+    adv = get_adversary("Lazy", mode="copy")
+    fr = FedRound(task=task, server=server, adversary=adv, batch_size=BATCH,
+                  num_batches_per_round=LOCAL_STEPS)
+    spec = AsyncSpec(seed=0, rate=rate, agg_every=agg_every,
+                     staleness_cap=8, weight_schedule="polynomial")
+    engine = AsyncEngine(fr, spec, num_clients, train_seed=0)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(num_clients, SHARD, 32, 32, 3)),
+                    jnp.float32)
+    y = jnp.asarray(rng.integers(0, 10, size=(num_clients, SHARD)), jnp.int32)
+    lengths = jnp.full((num_clients,), SHARD, jnp.int32)
+    mal = np.asarray(make_malicious_mask(num_clients, num_byzantine))
+    state = fr.init(jax.random.PRNGKey(0), num_clients)
+    import dataclasses as _dc
+
+    state = _dc.replace(
+        state, arrivals=engine.init_history(state.server.params))
+
+    # Compile + settle one cycle outside the timed window.
+    state, m = engine.run_cycle(state, (x, y, lengths), mal)
+    _ = float(m["train_loss"])
+    t0 = time.perf_counter()
+    for _i in range(timed_cycles):
+        state, metrics = engine.run_cycle(state, (x, y, lengths), mal)
+    final_loss = float(metrics["train_loss"])
+    assert final_loss == final_loss  # NaN guard
+    dt = time.perf_counter() - t0
+    info = engine.last_info
+    return {
+        "rounds_per_sec": round(timed_cycles / dt, 4),
+        "updates_per_sec": round(timed_cycles * agg_every / dt, 3),
+        "clients": num_clients, "byzantine": num_byzantine,
+        "model": "cnn", "batch": BATCH, "local_steps": LOCAL_STEPS,
+        "timed_cycles": timed_cycles, "aggregator": aggregator,
+        "adversary": "Lazy(copy)", "path": "async_buffered",
+        "arrival_rate": rate, "agg_every": agg_every,
+        "staleness_cap": spec.staleness_cap,
+        "weight_schedule": spec.weight_schedule,
+        "final_tick": info["tick"],
+        "staleness_mean": info["staleness_mean"],
+        "staleness_max": info["staleness_max"],
+        "buffer_overflow": info["buffer_overflow"],
+    }
+
+
+def _async_block(cpu: bool) -> dict:
+    """BLADES_BENCH_ASYNC satellite (ISSUE 14): the buffered-async
+    ingest measurement — updates/sec under a Poisson arrival process
+    next to rounds/sec, Lazy free-riders under a staleness-weighted
+    Median.  The reduced protocol rides both TPU main and
+    cpu_fallback."""
+    timed = 2 if cpu else 3
+    return _measure_async_cnn(timed_cycles=timed)
+
+
 def _cpu_fallback(probe_err: str) -> None:
     """The relay-dead-box path: measure a REDUCED configuration of the
     same pipeline (FedAvg + ALIE forge + exact Median, dense round, CPU
@@ -904,6 +982,14 @@ def _cpu_fallback(probe_err: str) -> None:
             out["trace"] = _trace_block(cpu=True)
         except Exception as e:
             out["trace"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+    if os.environ.get("BLADES_BENCH_ASYNC", "1") == "1":
+        try:
+            # Buffered-async ingest (ISSUE 14) on the reduced CPU
+            # config — updates/sec under Poisson arrivals + Lazy
+            # free-riders next to rounds/sec.
+            out["async"] = _async_block(cpu=True)
+        except Exception as e:
+            out["async"] = {"error": f"{type(e).__name__}: {e}"[:300]}
     _emit(out)
 
 
@@ -1012,6 +1098,17 @@ def main() -> None:
             out["trace"] = _trace_block(cpu=False)
         except Exception as e:
             out["trace"] = {"error": f"{type(e).__name__}: {e}"[:500]}
+
+    if os.environ.get("BLADES_BENCH_ASYNC", "1") == "1":
+        try:
+            # Buffered-async ingest (ISSUE 14): the 32-client CNN
+            # protocol under a Poisson arrival process with Lazy
+            # free-riders and staleness-weighted Median — updates/sec
+            # (the continuous-traffic metric) reported next to
+            # rounds/sec.
+            out["async"] = _async_block(cpu=False)
+        except Exception as e:
+            out["async"] = {"error": f"{type(e).__name__}: {e}"[:500]}
 
     _emit(out)
 

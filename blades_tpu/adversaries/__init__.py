@@ -30,6 +30,7 @@ from blades_tpu.adversaries.update_attacks import (  # noqa: F401
     AdaptiveAdversary,
     AttackclippedclusteringAdversary,
     IPMAdversary,
+    LazyAdversary,
     MinMaxAdversary,
     NoiseAdversary,
     SignGuardAdversary,
@@ -45,6 +46,10 @@ ADVERSARIES = {
     "Adaptive": AdaptiveAdversary,
     "SignGuard": SignGuardAdversary,
     "Attackclippedclustering": AttackclippedclusteringAdversary,
+    # Lazy/free-riding clients (BLADE-FL): stale-replay or copied
+    # updates — the adversary class the async arrival model exists to
+    # express (blades_tpu/arrivals).
+    "Lazy": LazyAdversary,
 }
 
 _ALIASES = {cls.__name__: cls for cls in ADVERSARIES.values()}

@@ -253,6 +253,89 @@ class SignGuardAdversary(Adversary):
 
 
 @dataclasses.dataclass(frozen=True)
+class LazyAdversary(Adversary):
+    """Lazy / free-riding clients (BLADE-FL, arXiv:2012.02044).
+
+    A lazy client skips its local training and ships plausible-looking
+    work anyway — the attack surface only an ASYNC server can fully
+    express, since "effort" there is a claim about WHICH model version
+    the update was computed against, not just its value:
+
+    - ``mode="copy"`` — plagiarism: every malicious lane submits a
+      keyed-random benign row (scaled by ``copy_scale``) plus small
+      Gaussian camouflage noise (``noise_std``), the BLADE-FL lazy miner
+      copying another's published update.  An ordinary update forge:
+      runs on the dense, async and d-sharded paths (the victim pick is
+      a LANE-axis draw, identical on every width shard); the streamed
+      path has no formulation for it and rejects it loudly like every
+      non-coordwise forge.
+    - ``mode="replay"`` — stale replay: under buffered-async execution
+      the cycle program (:mod:`blades_tpu.arrivals.cycle`) computes
+      malicious events against the OLDEST params retained in the
+      history ring regardless of their true pull (the
+      :attr:`wants_stale_replay` contract), so the free-rider ships
+      maximally stale work while claiming freshness; the forge hook
+      then adds the same camouflage noise.  In synchronous rounds there
+      is no version to lie about, so replay degenerates to scaling the
+      lane's own honest row by ``copy_scale`` + noise (minimal-effort
+      work, not plagiarized work).
+
+    Staleness-weighted robust aggregation is exactly the defense this
+    probes: copied rows pass row-geometry tests (they ARE benign
+    geometry), and replayed rows are only discounted if the server
+    weights staleness.
+    """
+
+    mode: str = "copy"
+    copy_scale: float = 1.0
+    noise_std: float = 1e-3
+
+    def __post_init__(self):
+        if self.mode not in ("copy", "replay"):
+            raise ValueError(
+                f"LazyAdversary mode must be 'copy' or 'replay', got "
+                f"{self.mode!r}")
+
+    @property
+    def wants_stale_replay(self) -> bool:
+        """Async-cycle contract: compute malicious events against the
+        oldest retained params version (see arrivals/cycle.py)."""
+        return self.mode == "replay"
+
+    def on_updates_ready(self, updates, malicious, key, *, aggregator=None,
+                         global_params=None, shard=None):
+        del aggregator, global_params
+        k_pick, k_noise = jax.random.split(key)
+        if shard is not None:
+            # The NoiseAdversary discipline: fold the shard index so the
+            # camouflage draw is i.i.d. across the full row instead of
+            # repeating every `width` coordinates.  k_pick stays
+            # UN-folded on purpose — the victim pick must replicate
+            # across shards so every chip copies the same lane.
+            k_noise = shard.fold(k_noise)
+        noise = self.noise_std * jax.random.normal(
+            k_noise, updates.shape, updates.dtype)
+        if shard is not None:
+            # Zero the padding columns so psum'd row geometry the
+            # defenses see stays exact (the Noise discipline).
+            noise = jnp.where(shard.valid()[None, :], noise, 0.0)
+        if self.mode == "copy":
+            # The plagiarized victim: the benign lane with the max keyed
+            # score — one victim per call, deterministically keyed, the
+            # same row on every layout (the draw is over lanes, not
+            # coordinates, so width sharding needs no global terms).
+            scores = jax.random.uniform(k_pick, (updates.shape[0],))
+            benign = ~malicious
+            victim = jnp.argmax(jnp.where(benign, scores, -jnp.inf))
+            forged = self.copy_scale * updates[victim][None, :] + noise
+        else:
+            # Replay: the rows already carry the stale (async) or honest
+            # (sync) work; scale + camouflage only.
+            forged = self.copy_scale * updates + noise
+        return jnp.where(malicious[:, None], forged, updates)
+
+
+@dataclasses.dataclass(frozen=True)
 class AttackclippedclusteringAdversary(Adversary):
     """Angle-chaining attack on clustering defenses
     (ref: attackclippedclustering_adversary.py:24-97).

@@ -99,11 +99,17 @@ class FedavgConfig:
         # program there); True forces, False disables.  Bit-transparent
         # either way.
         self.prefetch: Any = "auto"
-        # execution path: "auto" | "dense" | "streamed".  "streamed" runs
-        # the single-chip streaming round (parallel/streamed.py) whose
-        # bf16 (n, d) update matrix + block dispatches fit giant
-        # federations in one chip's HBM; "auto" picks it when the dense
-        # f32 matrix would strain HBM (> ~6 GB) and no mesh is requested.
+        # execution path: "auto" | "dense" | "streamed" | "dsharded" |
+        # "async".  "streamed" runs the single-chip streaming round
+        # (parallel/streamed.py) whose bf16 (n, d) update matrix + block
+        # dispatches fit giant federations in one chip's HBM; "auto"
+        # picks it when the dense f32 matrix would strain HBM (> ~6 GB)
+        # and no mesh is requested.  "async" replaces lockstep rounds
+        # with buffered-async execution (blades_tpu/arrivals): a
+        # deterministic Poisson arrival process drives clients that
+        # compute against the global model version they last pulled, and
+        # the server fires a staleness-weighted robust aggregation every
+        # K buffered arrivals (configure via .arrivals()).
         self.execution: str = "auto"
         self.client_block: int = 50        # clients per streamed dispatch
         self.d_chunk: int = 1 << 17        # coords per streamed agg chunk
@@ -173,6 +179,15 @@ class FedavgConfig:
         # The autotuner's reassociating tier probes this knob
         # (agg_domain in its plan space); the default tier never does.
         self.agg_domain: str = "f32"
+        # buffered-async execution (blades_tpu/arrivals): the arrival /
+        # buffering / staleness-weighting spec for execution="async",
+        # e.g. {"rate": 0.25, "agg_every": 16, "staleness_cap": 8,
+        # "weight_schedule": "polynomial"}.  The arrival seed defaults
+        # to the trial seed; set an explicit "seed" to pin the traffic
+        # realization across a training-seed grid.  None with
+        # execution="async" runs the AsyncSpec defaults; setting it
+        # WITHOUT execution="async" is a validate()-time error.
+        self.async_config: Optional[Dict] = None
         # defense forensics (obs subsystem): per-lane aggregator telemetry
         # + Byzantine detection precision/recall/FPR emitted from inside
         # the jitted round; dense single-chip execution only
@@ -283,6 +298,31 @@ class FedavgConfig:
         analogue is ``run_experiments(max_failures=)``."""
         return self._set(health_check=health_check, fault_config=faults)
 
+    def arrivals(self, *, rate=None, rate_schedule=None, slow_fraction=None,
+                 slow_factor=None, agg_every=None, buffer_capacity=None,
+                 staleness_cap=None, weight_schedule=None, weight_power=None,
+                 weight_cutoff=None, seed=None, max_ticks_per_cycle=None):
+        """Buffered-async arrival spec (:class:`blades_tpu.arrivals.
+        AsyncSpec`) for ``execution="async"``: the Poisson arrival rate
+        (+ schedule / slow-cohort knobs), the FedBuff buffer geometry
+        (``agg_every`` K, bounded ``buffer_capacity``), the params-
+        history depth (``staleness_cap`` H) and the staleness weight
+        schedule.  Merges into ``async_config``; see the README "Async
+        buffered execution" section."""
+        spec = dict(self.async_config or {})
+        for k, v in (("rate", rate), ("rate_schedule", rate_schedule),
+                     ("slow_fraction", slow_fraction),
+                     ("slow_factor", slow_factor), ("agg_every", agg_every),
+                     ("buffer_capacity", buffer_capacity),
+                     ("staleness_cap", staleness_cap),
+                     ("weight_schedule", weight_schedule),
+                     ("weight_power", weight_power),
+                     ("weight_cutoff", weight_cutoff), ("seed", seed),
+                     ("max_ticks_per_cycle", max_ticks_per_cycle)):
+            if v is not None:
+                spec[k] = v
+        return self._set(async_config=spec or None)
+
     def observability(self, *, forensics=None):
         """Defense forensics: per-lane aggregator diagnostics + Byzantine
         detection precision/recall/FPR per round (obs subsystem)."""
@@ -387,11 +427,65 @@ class FedavgConfig:
         if name in _NUM_CLASSES and self.num_classes == 10:
             self.num_classes = _NUM_CLASSES[name]
             self._inferred.add("num_classes")
-        if self.execution not in ("auto", "dense", "streamed", "dsharded"):
+        if self.execution not in ("auto", "dense", "streamed", "dsharded",
+                                  "async"):
             raise ValueError(
-                "execution must be auto|dense|streamed|dsharded, got "
-                f"{self.execution!r}"
+                "execution must be auto|dense|streamed|dsharded|async, "
+                f"got {self.execution!r}"
             )
+        if self.async_config and self.execution != "async":
+            raise ValueError(
+                "async_config is set but execution="
+                f"{self.execution!r}: the arrival spec only drives the "
+                "buffered-async path — set .resources(execution='async') "
+                "or drop .arrivals(...)"
+            )
+        if self.execution == "async":
+            # Build the spec now so a bad arrival/buffer/weight knob
+            # fails at validate() time (AsyncSpec.__post_init__ range-
+            # checks everything) — the faults/codec fail-fast discipline.
+            spec = self.get_async_spec()
+            if spec.agg_every > self.num_clients:
+                raise ValueError(
+                    f"async agg_every={spec.agg_every} > num_clients="
+                    f"{self.num_clients}: a cycle aggregates at most one "
+                    "event per client"
+                )
+            if self.num_devices and self.num_devices > 1:
+                raise ValueError(
+                    "execution='async' is single-chip for now: the cycle "
+                    "program has no mesh formulation — run without "
+                    "num_devices or use a synchronous path"
+                )
+            for knob, why in (
+                (self.forensics, "defense forensics"),
+                (self.codec_config, "update codecs"),
+                (self.agg_domain != "f32", "wire-domain aggregation"),
+                (self.client_packing not in ("off", None),
+                 "client lane-packing"),
+                (self.autotune_mode, "the execution autotuner"),
+                (int(self.rounds_per_dispatch or 1) != 1,
+                 "rounds_per_dispatch > 1"),
+                (self.chained_dispatch, "chained_dispatch"),
+                (self.health_check, "the in-round health check"),
+                (self.dp_clip_threshold, "client DP"),
+            ):
+                if knob:
+                    raise ValueError(
+                        f"execution='async' cannot compose with {why} "
+                        "yet: the buffered cycle aggregates arrival "
+                        "EVENTS, not the lockstep (n, d) round those "
+                        "stages are formulated over — drop the feature "
+                        "or use a synchronous execution path"
+                    )
+            injector = self.get_fault_injector()
+            if injector is not None and injector.num_stragglers:
+                raise ValueError(
+                    "execution='async' subsumes the straggler fault "
+                    "process (staleness is first-class in the arrival "
+                    "model); set num_stragglers=0 — dropout and "
+                    "corruption compose with async arrivals as-is"
+                )
         if self.execution == "dsharded":
             if not self.num_devices or self.num_devices < 2:
                 raise ValueError(
@@ -662,6 +756,24 @@ class FedavgConfig:
         # YAML-style dropout_schedule lists are normalized (sorted tuple of
         # (int, float) pairs) by FaultInjector.__post_init__ itself.
         return FaultInjector(**spec)
+
+    def get_async_spec(self):
+        """Build the buffered-async
+        :class:`~blades_tpu.arrivals.AsyncSpec` from ``async_config``
+        (None unless ``execution="async"``).  The arrival seed defaults
+        to the trial seed so a seed grid sweeps the traffic realizations
+        too; set an explicit ``seed`` in the spec to pin the arrival
+        process across a training-seed grid."""
+        if self.execution != "async":
+            return None
+        from blades_tpu.arrivals import AsyncSpec
+
+        spec = dict(self.async_config or {})
+        spec.setdefault("seed", int(self.seed))
+        if spec.get("rate_schedule") is not None:
+            spec["rate_schedule"] = tuple(
+                tuple(p) for p in spec["rate_schedule"])
+        return AsyncSpec(**spec)
 
     def get_codec(self):
         """Build the comm subsystem's

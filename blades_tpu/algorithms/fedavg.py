@@ -30,7 +30,7 @@ import numpy as np
 from blades_tpu.adversaries import make_malicious_mask
 from blades_tpu.core import FedRound
 from blades_tpu.data import DatasetCatalog
-from blades_tpu.obs.trace import Timers
+from blades_tpu.obs.trace import Timers, now
 from blades_tpu.perf.async_metrics import DEVICE_METRICS_KEY
 
 
@@ -110,12 +110,32 @@ class Fedavg:
                          and self._chunk > 1)
         self._prefetcher = None   # set by _setup_dense_pipeline when active
         self._cache_wrappers = []  # CachedFunctions feeding the obs counters
+        self._async = None        # AsyncEngine under execution="async"
         self.mesh = None
         # Client permutation applied to the stacked arrays (d-sharded
         # elision layout); None = natural order.  Checkpoints record it
         # so per-client state realigns across execution modes.
         self._client_order = None
-        if cfg.num_devices and cfg.num_devices > 1:
+        if cfg.execution == "async":
+            # Buffered-async execution (blades_tpu/arrivals): a host
+            # engine drives the virtual arrival clock, version vector and
+            # bounded buffer; each train() call is one aggregation cycle
+            # (one server round).  RoundState gains the (H+1, d) params-
+            # history ring so arriving clients compute against the
+            # version they actually pulled.
+            from blades_tpu.arrivals import AsyncEngine
+
+            self._async = AsyncEngine(
+                self.fed_round, cfg.get_async_spec(), cfg.num_clients,
+                train_seed=int(cfg.seed),
+                fault_injector=cfg.get_fault_injector(),
+            )
+            self.state = _dc_replace(
+                self.state,
+                arrivals=self._async.init_history(self.state.server.params))
+            self._step = None
+            self._evaluate = jax.jit(self.fed_round.evaluate)
+        elif cfg.num_devices and cfg.num_devices > 1:
             from blades_tpu.parallel import make_mesh, shard_federation, sharded_step
             from blades_tpu.parallel.sharded import sharded_evaluate, sharded_multi_step
 
@@ -837,8 +857,18 @@ class Fedavg:
         return self._train_raw(fetch=False)
 
     def _train_raw(self, fetch: bool) -> Dict:
+        cycle_t0 = now() if self._async is not None else None
         with self.timers.time("training_step"):
-            if self._chained:
+            if self._async is not None:
+                # One buffered-async cycle: the engine advances the
+                # virtual clock to the next full buffer and fires ONE
+                # aggregation dispatch.  The training key chain is
+                # untouched — per-event keys are pure in (seed, tick,
+                # client), so resume re-derives them from the
+                # checkpointed tick alone.
+                self.state, raw_metrics = self._async.run_cycle(
+                    self.state, self._train_arrays, self.malicious)
+            elif self._chained:
                 # The window program advances the key chain itself, one
                 # split per scanned round — handing back the carry a
                 # sequential driver would hold at the same round.
@@ -874,6 +904,24 @@ class Fedavg:
             DEVICE_METRICS_KEY: raw_metrics,
             "timers": self.timers.summary(),
         }
+        if self._async is not None:
+            # Host-side ingest digest (blades_tpu/arrivals): stamped at
+            # row creation — these are host ints the engine already
+            # holds, no device fetch to defer.  updates_per_sec is the
+            # one wall-clock field (the bench's ingest metric), measured
+            # through the span layer's sanctioned clock; everything else
+            # is deterministic and replay-comparable.
+            info = self._async.last_info
+            elapsed = max(now() - cycle_t0, 1e-9)
+            row["tick"] = int(info["tick"])
+            row["staleness_mean"] = float(info["staleness_mean"])
+            row["staleness_max"] = int(info["staleness_max"])
+            row["staleness_hist"] = [int(v) for v in info["staleness_hist"]]
+            row["buffer_fill"] = int(info["buffer_fill"])
+            row["arrivals_dropped"] = int(info["arrivals_dropped"])
+            row["buffer_overflow"] = int(info["buffer_overflow"])
+            row["arrival_seed"] = int(info["arrival_seed"])
+            row["updates_per_sec"] = round(info["events"] / elapsed, 3)
         if self._cache_wrappers:
             # Per-trial AOT compile-cache counters (obs schema fields):
             # cumulative over this trial's dispatches, so the first row
@@ -985,9 +1033,20 @@ class Fedavg:
             # Participation is per round; the dispatch summary reports the
             # LAST round (consistent with the scalar metrics above) plus
             # the static fault seed so a chaos run's stream is replayable.
-            for k in ("num_participating", "num_straggled", "num_dropped"):
-                row[k] = int(metrics[k])
+            # Async cycles carry no participation mask (dropped arrivals
+            # never enter the buffer; the drop counter rides the
+            # arrival stamps instead), so only the seed lands here.
+            if "num_participating" in metrics:
+                for k in ("num_participating", "num_straggled",
+                          "num_dropped"):
+                    row[k] = int(metrics[k])
             row["fault_seed"] = int(self.fed_round.faults.seed)
+        if "staleness_mean" in metrics:
+            # Sync straggler path's staleness summary (core/round.py) —
+            # the same schema fields the async stamps above use, so
+            # sync-vs-async staleness reads from one place.
+            row["staleness_mean"] = float(metrics["staleness_mean"])
+            row["staleness_max"] = int(metrics["staleness_max"])
         if self.config.health_check or self.config.forensics:
             u = np.asarray(raw["num_unhealthy"])
             row["num_unhealthy"] = int(u.sum() if idx is None
@@ -1121,6 +1180,13 @@ class Fedavg:
             # warns on a mismatch for direct-API resumes.
             "plan": (self._plan.as_dict() if self._plan is not None
                      else None),
+            # Buffered-async host state (blades_tpu/arrivals): the
+            # virtual tick, version vector, pending arrival buffer and
+            # drop counters — with the params-history ring already in
+            # `state`, everything kill-and-resume needs to replay the
+            # buffered trajectory bit-identically.
+            "arrivals": (self._async.host_state()
+                         if self._async is not None else None),
             "config_dict": {k: v for k, v in self.config.items()
                             if not callable(v)},
         }
@@ -1182,6 +1248,9 @@ class Fedavg:
                 # (comm subsystem); client axis is axis 0.
                 residual=(None if getattr(state, "residual", None) is None
                           else state.residual[remap]),
+                # The params-history ring has no client axis — versions
+                # are global — so it rides the remap unchanged.
+                arrivals=getattr(state, "arrivals", None),
             )
         import dataclasses as _dc
 
@@ -1205,6 +1274,30 @@ class Fedavg:
 
             _, _, d = ravel_fn(state.server.params)
             state = _dc.replace(state, residual=codec.init_residual(n, d))
+        if self._async is not None:
+            arr = payload.get("arrivals")
+            if arr:
+                self._async.restore_host_state(arr)
+            else:
+                # Checkpoint from a synchronous run (or from before the
+                # arrivals subsystem) resumed under execution='async':
+                # the arrival clock starts cold with the version counter
+                # synced to the restored round — a fresh traffic
+                # trajectory, NOT a bit-identical continuation.
+                warnings.warn(
+                    "checkpoint carries no arrivals payload; restarting "
+                    "the arrival process cold at version "
+                    f"{self._iteration} (the traffic trajectory will "
+                    "differ from the original run)", RuntimeWarning,
+                    stacklevel=2)
+                self._async.cold_reset(self._iteration)
+            if getattr(state, "arrivals", None) is None:
+                # No params-history ring in the checkpoint: seed every
+                # retained version with the restored params, exactly
+                # like a fresh init.
+                state = _dc.replace(
+                    state,
+                    arrivals=self._async.init_history(state.server.params))
         if self.mesh is not None:
             from blades_tpu.parallel import shard_federation
 

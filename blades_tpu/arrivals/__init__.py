@@ -1,0 +1,30 @@
+"""Buffered-async execution: serve continuous update traffic, not
+lockstep rounds (ROADMAP item 2).
+
+Layout:
+
+- :mod:`.process` — deterministic discrete-time Poisson arrival process,
+  realizations pure in ``(seed, tick)``;
+- :mod:`.weights` — staleness weight schedules (FedBuff polynomial
+  discount and friends);
+- :mod:`.buffer` — the host-side bounded arrival buffer (events, not
+  rows — trivially checkpointed);
+- :mod:`.cycle` — the pure jittable aggregation cycle (per-event local
+  rounds against versioned params from the history ring, staleness-
+  weighted robust aggregation);
+- :mod:`.engine` — the host driver: virtual clock, version vector,
+  chaos composition, checkpointable host state.
+
+Configure via ``FedavgConfig.resources(execution="async")`` +
+``FedavgConfig.arrivals(...)``; see the README "Async buffered
+execution" section.
+"""
+
+from blades_tpu.arrivals.buffer import ArrivalEvent, UpdateBuffer  # noqa: F401
+from blades_tpu.arrivals.engine import AsyncEngine, AsyncSpec  # noqa: F401
+from blades_tpu.arrivals.process import ArrivalProcess  # noqa: F401
+from blades_tpu.arrivals.weights import (  # noqa: F401
+    STALENESS_SCHEDULES,
+    normalized_row_scale,
+    staleness_weights,
+)

@@ -1,0 +1,362 @@
+"""AsyncEngine: the host driver of the buffered-async execution mode.
+
+The engine owns everything the cycle program (:mod:`.cycle`) must not
+trace: the virtual tick clock, the version vector (which global model
+version each client last pulled), the bounded arrival buffer, and the
+chaos-layer realization of dropout/corruption over arrivals.  All of it
+is deterministic host metadata — ints and short lists — checkpointed via
+:meth:`host_state` next to the pickled :class:`RoundState` and restored
+bit-identically.
+
+One :meth:`run_cycle` call is one server round:
+
+1. advance the virtual clock, realizing arrivals (pure in
+   ``(arrival_seed, tick)``) and the chaos layer's dropout/corruption
+   (pure in ``(fault_seed, tick)``) window-at-a-time, pushing surviving
+   arrivals into the bounded buffer (full buffer => overflow drop) and
+   advancing each arriving client's pulled version;
+2. once the buffer holds ``agg_every`` unique-client events, pop them
+   (FIFO) and fire ONE cycle dispatch: per-event local rounds against
+   the params versions the clients pulled, chaos corruption, adversary
+   forge, staleness-weighted robust aggregation, server step;
+3. report the host-side ingest digest (tick, staleness stats, buffer
+   occupancy, drop/overflow counters) for the metrics row.
+
+No wall clock is read here: time is the virtual tick, and the ingest
+*rate* (``updates_per_sec``) is measured by the driver through the span
+layer's sanctioned clock (:func:`blades_tpu.obs.trace.now`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from blades_tpu.arrivals.buffer import ArrivalEvent, UpdateBuffer
+from blades_tpu.arrivals.cycle import (
+    ASYNC_TRAIN_FOLD,
+    build_cycle,
+    cycle_agg_key,
+    init_history,
+)
+from blades_tpu.arrivals.process import ArrivalProcess
+from blades_tpu.arrivals.weights import STALENESS_SCHEDULES
+
+#: Ticks realized per host dispatch while filling the buffer.
+_REALIZE_WINDOW = 64
+
+
+@dataclasses.dataclass(frozen=True)
+class AsyncSpec:
+    """Static buffered-async config (``FedavgConfig.async_config``).
+
+    Attributes:
+        seed: arrival-process seed (defaults to the trial seed via
+            ``FedavgConfig.get_async_spec``); independent of the
+            training key.
+        rate / rate_schedule / slow_fraction / slow_factor: the
+            :class:`~blades_tpu.arrivals.process.ArrivalProcess` knobs.
+        agg_every: K — the server fires a robust aggregation every K
+            buffered arrivals (the FedBuff buffer size).
+        buffer_capacity: bounded-buffer capacity B >= K; arrivals past a
+            full buffer are dropped (``buffer_overflow``).  0 = ``2*K``.
+        staleness_cap: H — params-history depth; an update older than H
+            versions is computed against the oldest retained params
+            (true staleness still reported and weighted).
+        weight_schedule / weight_power / weight_cutoff: the staleness
+            discount (:mod:`blades_tpu.arrivals.weights`).
+        max_ticks_per_cycle: starvation guard — a cycle that cannot
+            collect K unique-client arrivals within this many ticks
+            raises instead of spinning forever.
+    """
+
+    seed: int = 0
+    rate: float = 0.25
+    rate_schedule: Optional[Tuple[Tuple[int, float], ...]] = None
+    slow_fraction: float = 0.0
+    slow_factor: float = 0.25
+    agg_every: int = 8
+    buffer_capacity: int = 0
+    staleness_cap: int = 8
+    weight_schedule: str = "polynomial"
+    weight_power: float = 0.5
+    weight_cutoff: int = 16
+    max_ticks_per_cycle: int = 100_000
+
+    def __post_init__(self):
+        if self.agg_every < 1:
+            raise ValueError(f"agg_every must be >= 1, got {self.agg_every}")
+        if self.buffer_capacity and self.buffer_capacity < self.agg_every:
+            raise ValueError(
+                f"buffer_capacity={self.buffer_capacity} < agg_every="
+                f"{self.agg_every}: the buffer could never hold one "
+                "aggregation batch")
+        if self.staleness_cap < 1:
+            raise ValueError(
+                f"staleness_cap must be >= 1, got {self.staleness_cap}")
+        if self.weight_schedule not in STALENESS_SCHEDULES:
+            raise ValueError(
+                f"weight_schedule must be one of {STALENESS_SCHEDULES}, "
+                f"got {self.weight_schedule!r}")
+        if self.weight_power <= 0:
+            raise ValueError(
+                f"weight_power must be > 0, got {self.weight_power}")
+        if self.weight_cutoff < 0:
+            raise ValueError(
+                f"weight_cutoff must be >= 0, got {self.weight_cutoff}")
+        if self.max_ticks_per_cycle < 1:
+            raise ValueError("max_ticks_per_cycle must be >= 1")
+        # Range checks of the process knobs fail fast here too.
+        self.process()
+
+    @property
+    def effective_capacity(self) -> int:
+        return self.buffer_capacity or 2 * self.agg_every
+
+    def process(self) -> ArrivalProcess:
+        return ArrivalProcess(
+            seed=self.seed, rate=self.rate,
+            rate_schedule=self.rate_schedule,
+            slow_fraction=self.slow_fraction,
+            slow_factor=self.slow_factor,
+        )
+
+
+class AsyncEngine:
+    """Host driver pairing an :class:`AsyncSpec` with a ``FedRound``."""
+
+    def __init__(self, fed_round, spec: AsyncSpec, num_clients: int, *,
+                 train_seed: int, fault_injector=None):
+        if spec.agg_every > num_clients:
+            raise ValueError(
+                f"agg_every={spec.agg_every} > num_clients={num_clients}: "
+                "a cycle aggregates at most one event per client")
+        if fault_injector is not None and fault_injector.num_stragglers:
+            raise ValueError(
+                "the async arrival model subsumes the straggler fault "
+                "process (staleness is first-class); configure "
+                "num_stragglers=0 under execution='async'")
+        self.fed_round = fed_round
+        self.spec = spec
+        self.num_clients = int(num_clients)
+        self.process = spec.process()
+        self.faults = fault_injector
+        corrupt_mode = (fault_injector.corrupt_mode
+                        if fault_injector is not None
+                        and fault_injector.corrupt_rate > 0.0 else None)
+        self._cycle = jax.jit(build_cycle(
+            fed_round, staleness_cap=spec.staleness_cap,
+            weight_schedule=spec.weight_schedule,
+            weight_power=spec.weight_power,
+            weight_cutoff=spec.weight_cutoff,
+            corrupt_mode=corrupt_mode,
+        ))
+        # Per-event training keys fold (seed, tick, client) off this base
+        # — the async analogue of the sync driver's split chain, with no
+        # chain state to checkpoint.
+        self._key_base = jax.random.fold_in(
+            jax.random.PRNGKey(int(train_seed)), ASYNC_TRAIN_FOLD)
+        self._realize = jax.jit(self._realize_window)
+
+        # -- deterministic host state (checkpointed via host_state) ----------
+        self.tick = 0                      # next virtual tick to realize
+        self.version = 0                   # global model version
+        self.client_versions = np.zeros(self.num_clients, np.int64)
+        self.buffer = UpdateBuffer(spec.effective_capacity)
+        self.arrivals_total = 0
+        self.arrivals_dropped = 0          # chaos dropout (never buffered)
+        self.buffer_overflow = 0           # full-buffer drops
+        self.last_info: Dict[str, Any] = {}
+
+    # -- realization ---------------------------------------------------------
+
+    def _realize_window(self, tick0):
+        """``(W, n)`` arrival / dropout / corruption realizations for
+        ticks ``tick0 .. tick0+W-1`` — each pure in its own
+        ``(seed, tick)`` stream (jitted once; W is static)."""
+        n = self.num_clients
+        arrivals = self.process.arrivals_window(tick0, _REALIZE_WINDOW, n)
+        if self.faults is None:
+            flat = jnp.zeros((_REALIZE_WINDOW, n), bool)
+            return arrivals, flat, flat
+
+        def one_tick(t):
+            # The sync injector's key discipline, per TICK instead of per
+            # round: realizations replay identically across resumes.
+            k_drop, _k_strag, k_corr = jax.random.split(
+                self.faults.round_key(t), 3)
+            drop = (jax.random.uniform(k_drop, (n,))
+                    < self.faults.dropout_rate_at(t))
+            corrupt = (jax.random.uniform(k_corr, (n,))
+                       < self.faults.corrupt_rate)
+            return drop, corrupt
+
+        ticks = tick0 + jnp.arange(_REALIZE_WINDOW)
+        drops, corrupts = jax.vmap(one_tick)(ticks)
+        return arrivals, drops, corrupts
+
+    def advance_until_ready(self) -> None:
+        """Advance the virtual clock until the buffer holds one
+        aggregation batch (``agg_every`` unique-client events)."""
+        k = self.spec.agg_every
+        start = self.tick
+        while self.buffer.unique_clients() < k:
+            if self.tick - start > self.spec.max_ticks_per_cycle:
+                raise RuntimeError(
+                    f"arrival starvation: {self.tick - start} ticks "
+                    f"without {k} unique-client arrivals (rate="
+                    f"{self.spec.rate}, buffer capacity "
+                    f"{self.buffer.capacity}) — raise the rate or shrink "
+                    "agg_every/buffer pressure")
+            arrivals, drops, corrupts = jax.device_get(
+                self._realize(self.tick))
+            for w in range(_REALIZE_WINDOW):
+                tick = self.tick
+                self.tick += 1
+                lanes = np.nonzero(arrivals[w])[0]
+                for c in map(int, lanes):
+                    self.arrivals_total += 1
+                    if drops[w, c]:
+                        # Chaos dropout: the delivery was lost in flight.
+                        # The client still pulls the current version and
+                        # keeps working (its send failed, its clock
+                        # didn't).
+                        self.arrivals_dropped += 1
+                        self.client_versions[c] = self.version
+                        continue
+                    # A full buffer loses one event per arrival: the new
+                    # one, or — when the arrival would grow the unique-
+                    # client set a fireable cycle needs — the oldest
+                    # duplicate-client event (UpdateBuffer's anti-
+                    # deadlock eviction).
+                    self.buffer_overflow += self.buffer.push(ArrivalEvent(
+                        client=c, tick=tick,
+                        version=int(self.client_versions[c]),
+                        corrupt=bool(corrupts[w, c])))
+                    # Delivered (or bounced off a full buffer): either
+                    # way the client pulls the current version.
+                    self.client_versions[c] = self.version
+                if self.buffer.unique_clients() >= k:
+                    break
+
+    # -- the cycle -----------------------------------------------------------
+
+    def run_cycle(self, state, train_arrays, malicious) -> Tuple[Any, dict]:
+        """One buffered-async server round.  Returns ``(new_state,
+        device_metrics)``; the host ingest digest lands in
+        :attr:`last_info`."""
+        spec = self.spec
+        self.advance_until_ready()
+        events = self.buffer.take_cycle(spec.agg_every)
+        staleness = np.asarray(
+            [self.version - ev.version for ev in events], np.int32)
+        clients = np.asarray([ev.client for ev in events], np.int32)
+        ticks = np.asarray([ev.tick for ev in events], np.int32)
+        corrupt = np.asarray([ev.corrupt for ev in events], bool)
+        mal_host = np.asarray(malicious)[clients]
+
+        if spec.weight_schedule == "cutoff":
+            # Host-visible degenerate case the jitted program cannot
+            # warn about: every buffered row past the cutoff means an
+            # all-zero weight vector — the cycle still runs (the server
+            # takes a ZERO step and the version advances; discarding an
+            # over-stale batch is the cutoff schedule's contract), but
+            # silently stalling training is operator-visible only here.
+            from blades_tpu.arrivals.weights import staleness_weights
+
+            if float(np.asarray(staleness_weights(
+                    "cutoff", staleness,
+                    cutoff=spec.weight_cutoff)).sum()) == 0.0:
+                import warnings
+
+                warnings.warn(
+                    f"async cycle at version {self.version}: every "
+                    f"buffered row exceeds weight_cutoff="
+                    f"{spec.weight_cutoff} (staleness "
+                    f"{staleness.tolist()}) — the aggregation batch is "
+                    "fully discarded and the server takes a zero step",
+                    RuntimeWarning, stacklevel=2)
+
+        data_x, data_y, lengths = train_arrays
+        k_agg = cycle_agg_key(self._key_base, self.version)
+        state, metrics = self._cycle(
+            state, data_x, data_y, lengths,
+            jnp.asarray(clients), jnp.asarray(ticks),
+            jnp.asarray(staleness), jnp.asarray(mal_host),
+            jnp.asarray(corrupt), self._key_base, k_agg,
+        )
+        self.version += 1
+
+        hist = np.bincount(
+            np.clip(staleness, 0, spec.staleness_cap + 1),
+            minlength=spec.staleness_cap + 2)
+        self.last_info = {
+            "tick": int(self.tick),
+            "events": int(spec.agg_every),
+            "staleness_mean": float(staleness.mean()),
+            "staleness_max": int(staleness.max()),
+            # Buckets 0..H plus one ">H" overflow bucket.
+            "staleness_hist": [int(v) for v in hist],
+            "buffer_fill": int(self.buffer.fill),
+            "arrivals_total": int(self.arrivals_total),
+            "arrivals_dropped": int(self.arrivals_dropped),
+            "buffer_overflow": int(self.buffer_overflow),
+            "arrival_seed": int(spec.seed),
+        }
+        return state, metrics
+
+    # -- state bootstrap / checkpointing -------------------------------------
+
+    def init_history(self, params) -> jax.Array:
+        """The ``RoundState.arrivals`` params-history ring at init."""
+        return init_history(params, self.spec.staleness_cap)
+
+    def host_state(self) -> Dict[str, Any]:
+        """Deterministic host state for the checkpoint payload; restoring
+        it via :meth:`restore_host_state` replays the buffered
+        trajectory bit-identically."""
+        return {
+            "tick": int(self.tick),
+            "version": int(self.version),
+            "client_versions": [int(v) for v in self.client_versions],
+            "buffer": self.buffer.state(),
+            "arrivals_total": int(self.arrivals_total),
+            "arrivals_dropped": int(self.arrivals_dropped),
+            "buffer_overflow": int(self.buffer_overflow),
+        }
+
+    def restore_host_state(self, payload: Dict[str, Any]) -> None:
+        versions = payload["client_versions"]
+        if len(versions) != self.num_clients:
+            raise ValueError(
+                f"checkpointed version vector covers {len(versions)} "
+                f"clients, this federation has {self.num_clients}")
+        self.tick = int(payload["tick"])
+        self.version = int(payload["version"])
+        self.client_versions = np.asarray(versions, np.int64)
+        self.buffer = UpdateBuffer(self.spec.effective_capacity)
+        self.buffer.restore(payload.get("buffer") or [])
+        self.arrivals_total = int(payload.get("arrivals_total", 0))
+        self.arrivals_dropped = int(payload.get("arrivals_dropped", 0))
+        self.buffer_overflow = int(payload.get("buffer_overflow", 0))
+        self.last_info = {}
+
+    def cold_reset(self, iteration: int) -> None:
+        """Resume WITHOUT a checkpointed arrivals payload (a checkpoint
+        from before this subsystem existed): restart the arrival clock
+        with the version counter synced to the restored round counter.
+        The traffic trajectory is fresh — bit-identity with the original
+        run is impossible and the caller warns."""
+        self.tick = 0
+        self.version = int(iteration)
+        self.client_versions = np.full(self.num_clients, int(iteration),
+                                       np.int64)
+        self.buffer = UpdateBuffer(self.spec.effective_capacity)
+        self.arrivals_total = 0
+        self.arrivals_dropped = 0
+        self.buffer_overflow = 0
+        self.last_info = {}

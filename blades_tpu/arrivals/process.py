@@ -1,0 +1,138 @@
+"""Deterministic Poisson arrival process: realizations pure in (seed, tick).
+
+Real clients arrive on their own clocks.  The simulator's clock is a
+VIRTUAL integer tick (no wall-clock read anywhere in this package's
+realization path — the trace-discipline lint fixture pair pins that), and
+the arrival process is the discrete-time Poisson process: at each tick
+every client independently arrives with Bernoulli probability ``rate``,
+so inter-arrival times are geometric — the discrete-time analogue of the
+exponential inter-arrival times of a continuous Poisson process, with
+mean ``1 / rate`` ticks between one client's deliveries.
+
+Determinism contract (the chaos layer's, verbatim): the arrival PRNG
+stream is ``fold_in(fold_in(PRNGKey(seed), _ARRIVAL_STREAM), tick)`` —
+pure in ``(seed, tick)``, independent of the training key — so the SAME
+arrival realization replays across retries, resumes, and execution
+modes.  A trial killed mid-stream and restored from a checkpoint
+re-experiences the identical traffic.
+
+Heterogeneous clocks: ``slow_fraction``/``slow_factor`` mark the LAST
+``floor(slow_fraction * n)`` client lanes as slow devices arriving at
+``rate * slow_factor`` (the static suffix mirrors the malicious-PREFIX
+convention of :func:`~blades_tpu.adversaries.make_malicious_mask`, so
+the two sets only overlap when both cover most of the federation) —
+slow clients deliver against older model versions, widening the
+staleness spectrum the weight schedules discount.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+#: Fold separating the arrival stream from the chaos layer's fault stream
+#: (``FaultInjector.round_key`` folds the bare ``PRNGKey(seed)``) when the
+#: two processes share a seed.
+_ARRIVAL_STREAM = 0x0A51
+
+
+@dataclasses.dataclass(frozen=True)
+class ArrivalProcess:
+    """Static arrival config; realizations are pure in ``(seed, tick)``.
+
+    Attributes:
+        seed: arrival-process seed, independent of the training key.
+        rate: per-client per-tick Bernoulli arrival probability (the
+            discrete-time Poisson intensity).
+        rate_schedule: optional ``((tick, rate), ...)`` piecewise-constant
+            override — from each listed tick on, arrivals run at that
+            rate (``rate`` applies before the first entry).  Models
+            diurnal traffic and flash crowds.
+        slow_fraction: fraction of clients (a static lane SUFFIX) whose
+            arrival rate is multiplied by ``slow_factor``.
+        slow_factor: rate multiplier for the slow cohort.
+    """
+
+    seed: int = 0
+    rate: float = 0.25
+    rate_schedule: Optional[Tuple[Tuple[int, float], ...]] = None
+    slow_fraction: float = 0.0
+    slow_factor: float = 0.25
+
+    def __post_init__(self):
+        if not 0.0 < self.rate <= 1.0:
+            raise ValueError(
+                f"rate must be in (0, 1], got {self.rate} (0 would mean "
+                "no client ever arrives)"
+            )
+        if not 0.0 <= self.slow_fraction <= 1.0:
+            raise ValueError(
+                f"slow_fraction must be in [0, 1], got {self.slow_fraction}"
+            )
+        if not 0.0 < self.slow_factor <= 1.0:
+            raise ValueError(
+                f"slow_factor must be in (0, 1], got {self.slow_factor} "
+                "(slow clients arrive less often, never more)"
+            )
+        if self.rate_schedule is not None:
+            # Normalize to a sorted tuple of (int, float) tuples: the
+            # process is static jit config and must stay hashable.
+            sched = tuple(sorted(
+                (int(t), float(v)) for t, v in self.rate_schedule))
+            for t, v in sched:
+                if t < 0 or not 0.0 < v <= 1.0:
+                    raise ValueError(
+                        f"rate_schedule entries must be (tick >= 0, rate "
+                        f"in (0, 1]), got ({t}, {v})"
+                    )
+            object.__setattr__(self, "rate_schedule", sched)
+
+    # -- realizations --------------------------------------------------------
+
+    def base_key(self) -> jax.Array:
+        """The arrival stream's root key — seed only, training key never."""
+        return jax.random.fold_in(
+            jax.random.PRNGKey(self.seed), _ARRIVAL_STREAM)
+
+    def tick_key(self, tick) -> jax.Array:
+        """The arrival PRNG key for one virtual tick: pure in
+        ``(seed, tick)``."""
+        return jax.random.fold_in(self.base_key(), tick)
+
+    def rate_at(self, tick) -> jax.Array:
+        """Piecewise-constant arrival rate at ``tick`` (traced-safe)."""
+        if not self.rate_schedule:
+            return jnp.float32(self.rate)
+        bounds = jnp.asarray([t for t, _ in self.rate_schedule], jnp.int32)
+        rates = jnp.asarray(
+            [self.rate] + [v for _, v in self.rate_schedule], jnp.float32)
+        return rates[jnp.searchsorted(bounds, tick, side="right")]
+
+    def client_rates(self, tick, num_clients: int) -> jax.Array:
+        """Per-lane arrival rates at ``tick``: the base rate with the
+        slow-suffix multiplier applied."""
+        r = self.rate_at(tick)
+        rates = jnp.full((num_clients,), r, jnp.float32)
+        num_slow = int(self.slow_fraction * num_clients)
+        if num_slow:
+            slow = jnp.arange(num_clients) >= num_clients - num_slow
+            rates = jnp.where(slow, r * jnp.float32(self.slow_factor), rates)
+        return rates
+
+    def arrivals_at(self, tick, num_clients: int) -> jax.Array:
+        """One tick's arrival realization: ``(n,)`` bool, client ``i``
+        delivered an update at ``tick``.  Pure in ``(seed, tick)``."""
+        u = jax.random.uniform(self.tick_key(tick), (num_clients,))
+        return u < self.client_rates(tick, num_clients)
+
+    def arrivals_window(self, tick0: int, num_ticks: int,
+                        num_clients: int) -> jax.Array:
+        """``(num_ticks, num_clients)`` bool — ticks ``tick0 ..
+        tick0 + num_ticks - 1`` realized at once (bit-identical to
+        per-tick :meth:`arrivals_at` calls; the host engine consumes
+        windows to amortize realization dispatches)."""
+        ticks = tick0 + jnp.arange(num_ticks)
+        return jax.vmap(lambda t: self.arrivals_at(t, num_clients))(ticks)

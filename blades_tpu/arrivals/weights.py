@@ -1,0 +1,65 @@
+"""Staleness weight schedules for buffered-async aggregation.
+
+A buffered row's staleness ``k = server_version - version the update was
+computed against`` (0 = computed against the current model).  Every
+schedule maps ``(K,)`` integer staleness to ``(K,)`` f32 weights; rows
+are then scaled by the MEAN-normalized weight
+(:func:`normalized_row_scale`) before the robust aggregator runs, so:
+
+- **Mean** returns exactly the staleness-weighted average
+  ``sum(w_i u_i) / sum(w_i)`` (the FedBuff fixed point);
+- every row-geometry defense (Median, Trimmedmean, Multikrum, GeoMed,
+  ...) sees stale rows geometrically discounted toward the origin — the
+  standard staleness-aware robustification (ByzFL frames this as the
+  open hard case; the discount is the conservative baseline).
+
+Schedules:
+
+==============  ==========================================================
+``constant``    ``w(k) = 1`` — staleness ignored (the ablation baseline)
+``polynomial``  ``w(k) = (1 + k)^-power`` — FedBuff's ``1/sqrt(1+k)`` at
+                the default ``power = 0.5``
+``inverse``     ``w(k) = 1 / (1 + k)``
+``cutoff``      ``w(k) = 1 if k <= cutoff else 0`` — hard staleness bound
+==============  ==========================================================
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+STALENESS_SCHEDULES = ("constant", "polynomial", "inverse", "cutoff")
+
+
+def staleness_weights(schedule: str, staleness, *, power: float = 0.5,
+                      cutoff: int = 16):
+    """``(K,)`` staleness ints -> ``(K,)`` f32 weights (pure, jittable;
+    ``schedule`` is static config)."""
+    k = jnp.asarray(staleness).astype(jnp.float32)
+    if schedule == "constant":
+        return jnp.ones_like(k)
+    if schedule == "polynomial":
+        return (1.0 + k) ** jnp.float32(-power)
+    if schedule == "inverse":
+        return 1.0 / (1.0 + k)
+    if schedule == "cutoff":
+        return (k <= jnp.float32(cutoff)).astype(jnp.float32)
+    raise ValueError(
+        f"unknown staleness weight schedule {schedule!r}; known: "
+        f"{STALENESS_SCHEDULES}"
+    )
+
+
+def normalized_row_scale(weights):
+    """Mean-normalized per-row scale ``w_i / mean(w)``: feeding
+    ``u_i * scale_i`` to a plain Mean yields exactly the weighted average
+    ``sum(w u) / sum(w)``, and an all-equal weight vector degenerates to
+    the identity (no schedule => bit-identical rows).
+
+    An ALL-ZERO weight vector (a ``cutoff`` cycle whose every row is
+    over-stale) scales every row to zero: the batch is discarded and the
+    server takes a zero step — the schedule's contract, surfaced loudly
+    by the host engine (``AsyncEngine.run_cycle`` warns) since a traced
+    program cannot."""
+    w = jnp.asarray(weights)
+    return w / jnp.maximum(w.mean(), 1e-12)

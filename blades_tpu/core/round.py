@@ -52,20 +52,29 @@ class RoundState:
     when-off discipline: only a top-k codec with error feedback adds the
     leaf, so codec-free (and identity-codec) pytrees/checkpoints are
     unchanged.
+
+    ``arrivals`` is the buffered-async subsystem's ``(H+1, d)``
+    params-history ring (see :mod:`blades_tpu.arrivals`): row ``j``
+    holds the raveled global params from ``j`` versions ago, so an
+    arriving client's update is computed against the version it actually
+    pulled.  Same ``None``-when-off discipline — only
+    ``execution="async"`` adds the leaf.
     """
 
     server: ServerState
     client_opt: Any  # pytree stacked over the client axis
     stale: Any = None
     residual: Any = None
+    arrivals: Any = None
 
 
 jax.tree_util.register_pytree_node(
     RoundState,
-    # getattr: checkpoints pickled before the chaos/comm layers existed
-    # restore as RoundState instances without `stale`/`residual`.
+    # getattr: checkpoints pickled before the chaos/comm/arrivals layers
+    # existed restore as RoundState instances without the late fields.
     lambda s: ((s.server, s.client_opt, getattr(s, "stale", None),
-                getattr(s, "residual", None)), None),
+                getattr(s, "residual", None),
+                getattr(s, "arrivals", None)), None),
     lambda _, c: RoundState(*c),
 )
 
@@ -426,6 +435,21 @@ class FedRound:
             metrics["num_participating"] = participation.sum().astype(jnp.int32)
             metrics["num_dropped"] = (~participation).sum().astype(jnp.int32)
             metrics["num_straggled"] = straggled.sum().astype(jnp.int32)
+            if self.faults.needs_stale_buffer:
+                # Staleness summary on the SYNC straggler path, in the
+                # same schema fields the async arrival rows stamp
+                # (blades_tpu/arrivals) — a straggled lane delivered the
+                # update it computed `staleness` rounds ago (age holds
+                # for the pre-warmup zeros too: they stand in for work
+                # that old), every other participant delivered fresh
+                # (age 0), so sync-vs-async staleness is comparable in
+                # one schema.
+                age = straggled.astype(jnp.float32) * jnp.float32(
+                    self.faults.staleness)
+                psum = jnp.maximum(
+                    participation.astype(jnp.float32).sum(), 1.0)
+                metrics["staleness_mean"] = age.sum() / psum
+                metrics["staleness_max"] = age.max().astype(jnp.int32)
         if self.health_check:
             from blades_tpu.core.health import guard_server_state
 
@@ -451,7 +475,8 @@ class FedRound:
             metrics["lane_scores"] = diag["scores"].astype(jnp.float32)
             metrics["lane_healthy"] = healthy_mask.astype(jnp.float32)
         return RoundState(server=server, client_opt=client_opt, stale=stale,
-                          residual=residual), metrics
+                          residual=residual,
+                          arrivals=getattr(state, "arrivals", None)), metrics
 
     def _finish_wire(
         self,
@@ -530,6 +555,7 @@ class FedRound:
         return RoundState(
             server=server, client_opt=client_opt,
             stale=getattr(state, "stale", None), residual=residual,
+            arrivals=getattr(state, "arrivals", None),
         ), metrics
 
     def multi_step(

@@ -164,6 +164,43 @@ class Server:
             )
         return self.apply_aggregate(state, agg, agg_state), agg
 
+    def step_buffered(
+        self,
+        state: ServerState,
+        updates: jax.Array,
+        *,
+        staleness: jax.Array,
+        key: Optional[jax.Array] = None,
+        trusted_update: Optional[jax.Array] = None,
+        schedule: str = "polynomial",
+        power: float = 0.5,
+        cutoff: int = 16,
+    ) -> Tuple[ServerState, jax.Array]:
+        """:meth:`step` for a buffered-async aggregation batch
+        (:mod:`blades_tpu.arrivals`): the ``(K, d)`` buffer rows are
+        scaled by the mean-normalized staleness weight ``w(k)/mean(w)``
+        BEFORE the robust aggregator runs, so Mean returns exactly the
+        staleness-weighted average ``sum(w u)/sum(w)`` (the FedBuff
+        fixed point) and every row-geometry defense sees stale rows
+        geometrically discounted.  ``staleness`` is the ``(K,)`` int
+        vector ``server_version - version the row was computed against``
+        (the host engine's accounting).  With the ``constant`` schedule
+        the scale is exactly 1 and this IS :meth:`step`, bit for bit.
+
+        No ``participation`` mask: every buffered row was delivered by
+        construction (dropped arrivals never enter the buffer).
+        """
+        from blades_tpu.arrivals.weights import (
+            normalized_row_scale,
+            staleness_weights,
+        )
+
+        w = staleness_weights(schedule, staleness, power=power,
+                              cutoff=cutoff)
+        scaled = updates * normalized_row_scale(w)[:, None]
+        return self.step(state, scaled, key=key,
+                         trusted_update=trusted_update)
+
     def step_wire(
         self,
         state: ServerState,

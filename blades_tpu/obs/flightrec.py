@@ -41,12 +41,17 @@ FLIGHTREC_VERSION = 1
 _FINITE_FIELDS = ("agg_norm", "train_loss", "update_norm_mean")
 
 #: Digest fields replay compares bit-for-bit (tools/replay_round.py):
-#: deterministic outputs of the round, never wall-clock.
+#: deterministic outputs of the round, never wall-clock.  The async
+#: ingest fields are deterministic too (virtual-tick clock, pure
+#: arrival realizations) — only updates_per_sec, the one wall-clock
+#: stamp, is deliberately absent.
 REPLAY_FIELDS = (
     "train_loss", "agg_norm", "update_norm_mean",
     "num_participating", "num_straggled", "num_dropped",
     "num_unhealthy", "byz_precision", "byz_recall", "byz_fpr",
     "num_flagged",
+    "tick", "staleness_mean", "staleness_max", "buffer_fill",
+    "buffer_overflow", "arrivals_dropped",
 )
 
 #: Wall-clock / run-shape fields dropped from digests — they vary run to

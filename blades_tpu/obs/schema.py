@@ -61,6 +61,31 @@ ROUND_RECORD_FIELDS: Dict[str, Tuple[tuple, bool]] = {
     "num_straggled": ((int,), False),
     "num_dropped": ((int,), False),
     "fault_seed": ((int,), False),
+    # Buffered-async execution (blades_tpu/arrivals): per-cycle ingest
+    # telemetry, stamped host-side by the driver.  Rows are TICK-indexed
+    # on top of round-indexed: `tick` is the virtual arrival clock when
+    # the aggregation fired (training_iteration stays the server round /
+    # model version).  staleness_* summarize the aggregated buffer's
+    # staleness k = server_version - version each row was computed
+    # against; the SYNC straggler path stamps the same staleness_mean/
+    # staleness_max so sync-vs-async rows compare in one schema.
+    # staleness_hist is the bucket counts [k=0, ..., k=H, k>H]
+    # (list-typed; the CSV sink skips it like watchdog_events).
+    # buffer_fill is the pending-event occupancy after the cycle;
+    # buffer_overflow / arrivals_dropped are cumulative full-buffer and
+    # chaos-dropout losses; updates_per_sec is the wall-clock ingest
+    # rate (the ONE non-replayable field — excluded from
+    # flightrec.REPLAY_FIELDS); arrival_seed pins the traffic
+    # realization like fault_seed pins the failure process.
+    "tick": ((int,), False),
+    "staleness_mean": (_NUM, False),
+    "staleness_max": ((int,), False),
+    "staleness_hist": ((list,), False),
+    "buffer_fill": ((int,), False),
+    "buffer_overflow": ((int,), False),
+    "arrivals_dropped": ((int,), False),
+    "updates_per_sec": (_NUM, False),
+    "arrival_seed": ((int,), False),
     # comm subsystem (blades_tpu/comm): per-round uplink byte accounting
     # for compressed-update codecs.  comm_bytes_up is the client->server
     # wire payload (reconciled against parallel/comm_model.uplink_bytes),
@@ -190,6 +215,12 @@ def validate_record(record: Any) -> Dict[str, Any]:
         for i, ev in enumerate(events):
             if not isinstance(ev, dict):
                 problems.append(f"watchdog_events[{i}] must be a dict")
+    hist = record.get("staleness_hist")
+    if isinstance(hist, list):
+        for i, v in enumerate(hist):
+            if not _type_ok(v, (int,)):
+                problems.append(f"staleness_hist[{i}] must be an int "
+                                f"bucket count, got {type(v).__name__}")
     if problems:
         raise SchemaError("; ".join(problems))
     return record

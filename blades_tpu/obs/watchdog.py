@@ -22,7 +22,13 @@ Rule kinds:
                      ``window`` values (warms up: silent until
                      ``min_points`` values seen)
 ``ceiling``          field >= ``threshold`` (detection-FPR collapse:
-                     the defense started flagging the benign cohort)
+                     the defense started flagging the benign cohort;
+                     staleness runaway: the async buffer is serving
+                     ancient work)
+``collapse``         field < rolling median / ``factor`` — the low-side
+                     twin of ``spike`` (ingest-rate regression: the
+                     async server's ``updates_per_sec`` fell off a
+                     cliff / buffer starvation)
 ``round_time_regression``
                      per-round wall time (the delta of the row's
                      ``timers.training_step.total_s``) > ``factor`` x
@@ -47,7 +53,8 @@ from typing import Any, Dict, List, Optional, Sequence
 
 from blades_tpu.obs.schema import ROUND_RECORD_FIELDS
 
-_KINDS = ("nonfinite", "spike", "ceiling", "round_time_regression")
+_KINDS = ("nonfinite", "spike", "ceiling", "collapse",
+          "round_time_regression")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -112,6 +119,15 @@ def default_rules() -> tuple:
         WatchdogRule(name="round_time_regression",
                      kind="round_time_regression", field="timers",
                      window=8, min_points=4, factor=3.0),
+        # Buffered-async ingest health (blades_tpu/arrivals): both rules
+        # watch fields only async rows stamp, so they are inert on
+        # synchronous trials (absent field => skipped) and warm-on-
+        # resume like every other rule.
+        WatchdogRule(name="staleness_runaway", kind="ceiling",
+                     field="staleness_max", threshold=64.0),
+        WatchdogRule(name="ingest_collapse", kind="collapse",
+                     field="updates_per_sec", window=8, min_points=4,
+                     factor=4.0),
     )
 
 
@@ -207,24 +223,37 @@ class Watchdog:
                     message=f"{rule.field}={value:.4g} breached the "
                             f"{rule.threshold:.4g} ceiling")
             return None
-        # Rolling-median kinds: spike / round_time_regression.  A
-        # non-finite value never enters the window (it would poison the
-        # median) — the nonfinite rule owns that pathology.
+        # Rolling-median kinds: spike / collapse /
+        # round_time_regression.  A non-finite value never enters the
+        # window (it would poison the median) — the nonfinite rule owns
+        # that pathology.
         window = self._windows[rule.name]
         event = None
         if math.isfinite(value):
             if len(window) >= rule.min_points:
                 med = _median(window)
-                limit = rule.factor * med
-                if med > 0 and value > limit:
-                    what = ("round wall-time"
-                            if rule.kind == "round_time_regression"
-                            else rule.field)
-                    event = WatchdogEvent(
-                        rule=rule.name, kind=rule.kind, field=rule.field,
-                        round=tick, value=value, limit=limit,
-                        message=f"{what}={value:.4g} > {rule.factor:g}x "
-                                f"rolling median ({med:.4g})")
+                if rule.kind == "collapse":
+                    limit = med / rule.factor
+                    if med > 0 and value < limit:
+                        event = WatchdogEvent(
+                            rule=rule.name, kind=rule.kind,
+                            field=rule.field, round=tick, value=value,
+                            limit=limit,
+                            message=f"{rule.field}={value:.4g} < rolling "
+                                    f"median ({med:.4g}) / {rule.factor:g}")
+                else:
+                    limit = rule.factor * med
+                    if med > 0 and value > limit:
+                        what = ("round wall-time"
+                                if rule.kind == "round_time_regression"
+                                else rule.field)
+                        event = WatchdogEvent(
+                            rule=rule.name, kind=rule.kind,
+                            field=rule.field, round=tick, value=value,
+                            limit=limit,
+                            message=f"{what}={value:.4g} > "
+                                    f"{rule.factor:g}x rolling median "
+                                    f"({med:.4g})")
             window.append(value)
         return event
 

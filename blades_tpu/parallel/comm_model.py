@@ -138,10 +138,12 @@ def _adversary_volumes(adversary: Optional[str], n: int,
     per-coordinate Fang deviation, Noise's keyed draw) and the
     training-side attacks (SignFlip, LabelFlip) need NO cross-shard
     reduction on the width-sharded layout: every chip holds full rows of
-    its own columns."""
+    its own columns.  Lazy (the BLADE-FL free-rider) is collective-free
+    too: its victim pick is a keyed draw over the LANE axis (replicated
+    per shard) and its camouflage noise is per-coordinate."""
     f4 = 4
     if adversary in (None, "ALIE", "IPM", "Adaptive", "Noise", "SignFlip",
-                     "LabelFlip"):
+                     "LabelFlip", "Lazy"):
         return []
     if adversary == "MinMax":
         # pairwise dists among benign rows + one distance-norm psum per

@@ -150,6 +150,22 @@ def main(argv=None) -> int:
                        "iff the width/divisibility heuristic passes, loud "
                        "fallback otherwise), an int P>=2 to force, 'off' "
                        "(default; see README \"Client packing\")")
+    p_run.add_argument("--execution", default=None,
+                       choices=("auto", "dense", "streamed", "dsharded",
+                                "async"),
+                       help="execution path override; 'async' runs the "
+                       "buffered-async mode (blades_tpu/arrivals): a "
+                       "deterministic Poisson arrival process, clients "
+                       "computing against the version they last pulled, "
+                       "staleness-weighted robust aggregation every K "
+                       "buffered arrivals (see README \"Async buffered "
+                       "execution\")")
+    p_run.add_argument("--arrivals-json", default=None, metavar="SPEC",
+                       help="async arrival spec as JSON for "
+                       "--execution async, e.g. '{\"rate\": 0.25, "
+                       "\"agg_every\": 16, \"weight_schedule\": "
+                       "\"polynomial\"}' (AsyncSpec knobs; seed defaults "
+                       "to the trial seed)")
 
     args = parser.parse_args(argv)
     scan_window = (args.scan_window if args.scan_window == "auto"
@@ -197,6 +213,10 @@ def main(argv=None) -> int:
             cp = args.client_packing
             run_config["client_packing"] = (cp if cp in ("auto", "off")
                                             else int(cp))
+        if args.execution is not None:
+            run_config["execution"] = args.execution
+        if args.arrivals_json is not None:
+            run_config["async_config"] = json.loads(args.arrivals_json)
         experiments = {
             f"{args.algo.lower()}_run": {
                 "run": args.algo,

@@ -637,6 +637,18 @@ def _comm_summary(row: Dict) -> Optional[Dict]:
     return comm or None
 
 
+def _arrivals_summary(row: Dict) -> Optional[Dict]:
+    """The buffered-async ingest slice for trial summaries (the final
+    row's cumulative counters and staleness digest stand for the trial;
+    updates_per_sec is the last cycle's wall-clock ingest rate)."""
+    arr = {k: row[k] for k in ("tick", "updates_per_sec",
+                               "staleness_mean", "staleness_max",
+                               "buffer_fill", "buffer_overflow",
+                               "arrivals_dropped", "arrival_seed")
+           if k in row}
+    return arr if "tick" in arr else None
+
+
 def run_experiments(
     experiments: Dict[str, Dict],
     storage_path: str = "~/blades_tpu_results",
@@ -1267,6 +1279,11 @@ def run_experiments(
                 # Codec byte accounting (blades_tpu/comm), mirrored from
                 # the per-round metrics stream into the trial summary.
                 summary["comm"] = comm
+            arrivals = _arrivals_summary(last_row)
+            if arrivals:
+                # Buffered-async ingest digest (blades_tpu/arrivals),
+                # mirrored from the final row like the comm block.
+                summary["arrivals"] = arrivals
             packing = getattr(algo, "packing_summary", None)
             if packing:
                 # Lane-packing decision (parallel/packed.py): present

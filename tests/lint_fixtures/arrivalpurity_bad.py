@@ -1,0 +1,27 @@
+"""Seeded arrival-realization IMPURITY (never imported; excluded from
+the default tree scan): an arrival process whose "tick" comes from the
+wall clock.  The buffered-async determinism contract (blades_tpu/
+arrivals) requires realizations pure in (seed, tick) with tick a
+VIRTUAL counter — every Date-style clock read below must be caught by
+the trace-discipline pass."""
+
+import time
+from time import monotonic as mono
+
+
+def tick_from_wall_clock(epoch_start):
+    # A wall-clock-derived tick: two runs of the same seed would realize
+    # DIFFERENT arrival masks — kill-and-resume could never replay.
+    return int(time.time() - epoch_start)
+
+
+def arrivals_at_now(process, num_clients, epoch_start):
+    tick = int(mono() - epoch_start)   # aliased from-import form
+    return process.arrivals_at(tick, num_clients)
+
+
+def ingest_rate_raw(events):
+    # Even the rate measurement must flow through the span layer's
+    # sanctioned clock, not a raw perf counter.
+    t0 = time.perf_counter()
+    return events / max(time.perf_counter() - t0, 1e-9)

@@ -1,0 +1,22 @@
+"""Clean twin of arrivalpurity_bad.py: the arrival process advances a
+VIRTUAL tick counter (pure in (seed, tick) — no wall clock anywhere in
+the realization path), and the one sanctioned wall-clock need — the
+ingest-rate measurement — reads the span layer's ``obs.trace.now()``."""
+
+from blades_tpu.obs.trace import now
+
+
+def advance_virtual_tick(tick):
+    # The ONLY clock the arrival model knows: an integer the engine
+    # increments — checkpointed, replayed, bit-identical on resume.
+    return tick + 1
+
+
+def arrivals_at_tick(process, tick, num_clients):
+    return process.arrivals_at(tick, num_clients)
+
+
+def ingest_rate_spanned(events, cycle_start):
+    # updates_per_sec through the sanctioned clock (the driver's
+    # pattern in algorithms/fedavg.py).
+    return events / max(now() - cycle_start, 1e-9)

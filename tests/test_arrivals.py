@@ -1,0 +1,647 @@
+"""Buffered-async execution (ISSUE 14, blades_tpu/arrivals).
+
+Layers under test:
+
+1. **Arrival realizations** — pure in ``(seed, tick)``, windowed
+   realization bit-identical to per-tick, schedule/slow-cohort shaping.
+2. **Buffer + weights** — bounded FIFO with unique-client cycles,
+   staleness weight schedules and the Mean-exact normalized scale.
+3. **The async driver** — determinism across rebuilds, kill-and-resume
+   bit-identity of the buffer + version vector + params-history ring,
+   chaos (dropout / corruption) composing with arrivals, the Lazy
+   free-rider adversary, the ≥3-aggregator acceptance zoo.
+4. **Observability** — schema-valid tick-indexed rows, watchdog
+   staleness/ingest rules (warm-on-resume), flight-recorder replay to a
+   recorded tick, the sync straggler path's staleness stamps.
+"""
+
+import json
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from blades_tpu.arrivals import (
+    ArrivalEvent,
+    ArrivalProcess,
+    AsyncSpec,
+    UpdateBuffer,
+    normalized_row_scale,
+    staleness_weights,
+)
+
+N = 8  # tiny-federation size for the driver tests
+
+
+def _async_config(**over):
+    from blades_tpu.algorithms.config import FedavgConfig
+
+    arrivals = {"rate": 0.4, "agg_every": 4, "staleness_cap": 4}
+    arrivals.update(over.pop("arrivals", {}))
+    cfg = (FedavgConfig()
+           .data(dataset="mnist", num_clients=N, seed=7)
+           .training(global_model="mlp",
+                     aggregator=over.pop("aggregator", {"type": "Median"}))
+           .resources(execution="async")
+           .arrivals(**arrivals))
+    for k, v in over.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+def _close_or_both_nan(a, b):
+    return (a == b) or (np.isnan(a) and np.isnan(b))
+
+
+# ---------------------------------------------------------------------------
+# arrival process
+# ---------------------------------------------------------------------------
+
+
+def test_arrival_realizations_pure_in_seed_and_tick():
+    p = ArrivalProcess(seed=3, rate=0.5)
+    a = np.asarray(p.arrivals_at(17, 16))
+    b = np.asarray(ArrivalProcess(seed=3, rate=0.5).arrivals_at(17, 16))
+    assert np.array_equal(a, b)
+    # Ticks decorrelate, seeds decorrelate.
+    assert not np.array_equal(a, np.asarray(p.arrivals_at(18, 16)))
+    assert not np.array_equal(
+        a, np.asarray(ArrivalProcess(seed=4, rate=0.5).arrivals_at(17, 16)))
+    # The arrival stream is independent of the TRAINING key: nothing
+    # here consumes global state, so interleaving draws changes nothing.
+    jax.random.normal(jax.random.PRNGKey(123), (4,))
+    assert np.array_equal(a, np.asarray(p.arrivals_at(17, 16)))
+
+
+def test_arrival_window_matches_per_tick():
+    p = ArrivalProcess(seed=9, rate=0.3)
+    win = np.asarray(p.arrivals_window(5, 6, 12))
+    singles = np.stack([np.asarray(p.arrivals_at(5 + t, 12))
+                        for t in range(6)])
+    assert np.array_equal(win, singles)
+
+
+def test_arrival_rate_schedule_and_slow_cohort():
+    p = ArrivalProcess(seed=0, rate=0.2,
+                       rate_schedule=((10, 0.9), (20, 0.05)))
+    assert float(p.rate_at(0)) == pytest.approx(0.2)
+    assert float(p.rate_at(10)) == pytest.approx(0.9)
+    assert float(p.rate_at(19)) == pytest.approx(0.9)
+    assert float(p.rate_at(25)) == pytest.approx(0.05)
+    slow = ArrivalProcess(seed=0, rate=0.8, slow_fraction=0.5,
+                          slow_factor=0.25)
+    rates = np.asarray(slow.client_rates(0, 8))
+    assert np.allclose(rates[:4], 0.8) and np.allclose(rates[4:], 0.2)
+    # Over many ticks the slow suffix really arrives less.
+    win = np.asarray(slow.arrivals_window(0, 200, 8))
+    assert win[:, :4].mean() > 2.5 * win[:, 4:].mean()
+
+
+def test_arrival_process_validation():
+    with pytest.raises(ValueError, match="rate"):
+        ArrivalProcess(rate=0.0)
+    with pytest.raises(ValueError, match="slow_factor"):
+        ArrivalProcess(slow_factor=1.5)
+    with pytest.raises(ValueError, match="rate_schedule"):
+        ArrivalProcess(rate_schedule=((5, 1.7),))
+
+
+# ---------------------------------------------------------------------------
+# buffer + weights
+# ---------------------------------------------------------------------------
+
+
+def test_update_buffer_fifo_overflow_and_unique_clients():
+    buf = UpdateBuffer(capacity=4)
+    assert buf.push(ArrivalEvent(0, 1, 0)) == 0
+    assert buf.push(ArrivalEvent(1, 1, 0)) == 0
+    assert buf.push(ArrivalEvent(0, 2, 0)) == 0  # duplicate buffers fine
+    assert buf.push(ArrivalEvent(2, 2, 0)) == 0
+    # Full + distinct client: ONE event is lost — the oldest duplicate
+    # (client 0's tick-1) evicts so the unique set still grows.
+    assert buf.push(ArrivalEvent(3, 3, 0)) == 1
+    assert buf.fill == 4 and buf.unique_clients() == 4
+    cycle = buf.take_cycle(3)
+    # FIFO over the survivors: client 1's tick-1, client 0's tick-2,
+    # client 2's tick-2.
+    assert [e.client for e in cycle] == [1, 0, 2]
+    assert [e.tick for e in cycle] == [1, 2, 2]
+    assert buf.fill == 1 and buf._events[0].client == 3
+    with pytest.raises(ValueError, match="unique-client"):
+        buf.take_cycle(2)
+
+
+def test_update_buffer_eviction_prevents_unique_client_deadlock():
+    """A full buffer below k unique clients must not be absorbing: a new
+    DISTINCT client's arrival evicts the oldest duplicate-client event
+    (counted as an overflow loss), so the unique set can always grow to
+    a fireable cycle; a duplicate arrival on a full buffer still drops."""
+    buf = UpdateBuffer(capacity=4)
+    for tick in range(4):
+        assert buf.push(ArrivalEvent(tick % 2, tick, 0)) == 0
+    assert buf.fill == 4 and buf.unique_clients() == 2
+    # Duplicate client on a full buffer: the NEW event drops.
+    assert buf.push(ArrivalEvent(0, 9, 0)) == 1
+    assert buf.unique_clients() == 2
+    # Distinct clients on a full buffer: oldest duplicates evict, one
+    # loss each, and the unique set grows until a 4-cycle can fire.
+    assert buf.push(ArrivalEvent(2, 10, 0)) == 1
+    assert buf.push(ArrivalEvent(3, 11, 0)) == 1
+    assert buf.unique_clients() == 4
+    # Oldest duplicates (client 0's tick-0, client 1's tick-1 events)
+    # were the evictees; survivors stay FIFO.
+    assert [e.client for e in buf.take_cycle(4)] == [0, 1, 2, 3]
+
+
+def test_async_engine_slow_client_does_not_starve():
+    """The reviewer scenario: agg_every == num_clients with a slow-lane
+    cohort — the fast clients fill the buffer long before the slow one
+    first arrives.  Eviction keeps a slot reachable, so cycles fire
+    instead of spinning into the starvation guard."""
+    def build():
+        return _async_config(
+            arrivals={"rate": 0.6, "agg_every": 8, "staleness_cap": 4,
+                      "slow_fraction": 0.125, "slow_factor": 0.05})
+
+    algo = build().build()
+    rows = [algo.train() for _ in range(2)]
+    assert rows[-1]["training_iteration"] == 2
+    assert rows[-1]["buffer_overflow"] >= 0  # losses counted, no deadlock
+
+
+def test_update_buffer_state_roundtrip():
+    buf = UpdateBuffer(capacity=8)
+    buf.push(ArrivalEvent(3, 11, 2, True))
+    buf.push(ArrivalEvent(1, 12, 4, False))
+    clone = UpdateBuffer(capacity=8)
+    clone.restore(buf.state())
+    assert clone.state() == buf.state()
+    assert clone._events[0] == ArrivalEvent(3, 11, 2, True)
+
+
+def test_staleness_weight_schedules():
+    k = jnp.asarray([0, 1, 3, 20])
+    assert np.allclose(staleness_weights("constant", k), 1.0)
+    assert np.allclose(staleness_weights("polynomial", k, power=0.5),
+                       [1.0, 2 ** -0.5, 0.5, 21 ** -0.5])
+    assert np.allclose(staleness_weights("inverse", k),
+                       [1.0, 0.5, 0.25, 1 / 21])
+    assert np.allclose(staleness_weights("cutoff", k, cutoff=3),
+                       [1.0, 1.0, 1.0, 0.0])
+    with pytest.raises(ValueError, match="schedule"):
+        staleness_weights("wat", k)
+    # Mean-exactness: scaled rows through a plain mean == weighted mean.
+    u = jnp.asarray(np.random.default_rng(0).normal(size=(4, 5)),
+                    jnp.float32)
+    w = staleness_weights("polynomial", k)
+    scaled = u * normalized_row_scale(w)[:, None]
+    want = (u * w[:, None]).sum(0) / w.sum()
+    assert np.allclose(scaled.mean(0), want, rtol=1e-6)
+    # Constant weights are the exact identity (bit-for-bit).
+    ident = u * normalized_row_scale(jnp.ones(4))[:, None]
+    assert np.array_equal(np.asarray(ident), np.asarray(u))
+
+
+def test_async_spec_validation():
+    with pytest.raises(ValueError, match="buffer_capacity"):
+        AsyncSpec(agg_every=8, buffer_capacity=4)
+    with pytest.raises(ValueError, match="weight_schedule"):
+        AsyncSpec(weight_schedule="nope")
+    with pytest.raises(ValueError, match="staleness_cap"):
+        AsyncSpec(staleness_cap=0)
+    assert AsyncSpec(agg_every=8).effective_capacity == 16
+
+
+# ---------------------------------------------------------------------------
+# config surface
+# ---------------------------------------------------------------------------
+
+
+def test_config_gates():
+    from blades_tpu.algorithms.config import FedavgConfig
+
+    with pytest.raises(ValueError, match="async_config is set"):
+        FedavgConfig().arrivals(rate=0.5).validate()
+    with pytest.raises(ValueError, match="forensics"):
+        _async_config(forensics=True).validate()
+    with pytest.raises(ValueError, match="codec"):
+        _async_config(codec_config={"type": "quant", "bits": 8}).validate()
+    with pytest.raises(ValueError, match="agg_every"):
+        _async_config(arrivals={"agg_every": 64}).validate()
+    with pytest.raises(ValueError, match="straggler"):
+        _async_config(
+            fault_config={"num_stragglers": 1, "staleness": 2}).validate()
+    with pytest.raises(ValueError, match="autotuner"):
+        _async_config(autotune=True).validate()
+    # Dropout/corruption chaos composes — validates clean.
+    _async_config(fault_config={"dropout_rate": 0.2,
+                                "corrupt_rate": 0.1}).validate()
+    # The arrival seed defaults to the trial seed; an explicit one pins.
+    assert _async_config().get_async_spec().seed == 7
+    assert _async_config(
+        arrivals={"seed": 42}).get_async_spec().seed == 42
+
+
+# ---------------------------------------------------------------------------
+# lazy / free-rider adversary
+# ---------------------------------------------------------------------------
+
+
+def test_lazy_adversary_copy_and_replay():
+    from blades_tpu.adversaries import get_adversary
+
+    rng = np.random.default_rng(1)
+    updates = jnp.asarray(rng.normal(size=(6, 16)), jnp.float32)
+    mal = jnp.arange(6) < 2
+    key = jax.random.PRNGKey(5)
+
+    adv = get_adversary("Lazy", mode="copy", noise_std=0.0)
+    out = np.asarray(adv.on_updates_ready(updates, mal, key))
+    # Benign rows untouched; malicious rows are a copy of ONE benign row.
+    assert np.array_equal(out[2:], np.asarray(updates)[2:])
+    victims = [i for i in range(2, 6)
+               if np.array_equal(out[0], np.asarray(updates)[i])]
+    assert len(victims) == 1 and np.array_equal(out[0], out[1])
+    # Deterministic in the key.
+    again = np.asarray(adv.on_updates_ready(updates, mal, key))
+    assert np.array_equal(out, again)
+
+    replay = get_adversary("Lazy", mode="replay", copy_scale=0.5,
+                           noise_std=0.0)
+    assert replay.wants_stale_replay
+    out2 = np.asarray(replay.on_updates_ready(updates, mal, key))
+    assert np.allclose(out2[:2], 0.5 * np.asarray(updates)[:2])
+    assert np.array_equal(out2[2:], np.asarray(updates)[2:])
+    with pytest.raises(ValueError, match="mode"):
+        get_adversary("Lazy", mode="sloth")
+
+
+# ---------------------------------------------------------------------------
+# the async driver: determinism, resume, chaos, adversaries
+# ---------------------------------------------------------------------------
+
+
+def _run_rows(cfg_builder, rounds):
+    algo = cfg_builder().build()
+    return algo, [algo.train() for _ in range(rounds)]
+
+
+_REPLAYABLE = ("train_loss", "agg_norm", "update_norm_mean", "tick",
+               "staleness_mean", "staleness_max", "buffer_fill",
+               "buffer_overflow", "arrivals_dropped")
+
+
+@pytest.mark.slow  # the resume test below pins replay determinism in tier-1
+def test_async_rows_deterministic_across_rebuilds():
+    _, rows_a = _run_rows(_async_config, 4)
+    _, rows_b = _run_rows(_async_config, 4)
+    for ra, rb in zip(rows_a, rows_b):
+        for k in _REPLAYABLE:
+            assert ra[k] == rb[k], k
+    # Ticks never go backwards; staleness summaries are coherent.
+    ticks = [r["tick"] for r in rows_a]
+    assert ticks == sorted(ticks)
+    for r in rows_a:
+        assert r["staleness_mean"] <= r["staleness_max"]
+        assert sum(r["staleness_hist"]) == 4  # agg_every events
+
+
+def test_async_kill_and_resume_bit_identical(tmp_path):
+    """The acceptance contract: buffer + version vector + params-history
+    ring checkpointed like the EF residual and stale ring — a restored
+    trial replays rows AND full RoundState bit-for-bit."""
+    algo_a, rows_a = _run_rows(_async_config, 6)
+
+    b = _async_config().build()
+    for _ in range(3):
+        b.train()
+    b.save_checkpoint(str(tmp_path))
+    c = _async_config().build()
+    c.load_checkpoint(str(tmp_path))
+    # Host state restored exactly (version vector, buffer, counters).
+    assert c._async.host_state() == b._async.host_state()
+    rows_c = [c.train() for _ in range(3)]
+    for ra, rc in zip(rows_a[3:], rows_c):
+        for k in _REPLAYABLE:
+            assert ra[k] == rc[k], k
+    for pa, pc in zip(jax.tree.leaves(algo_a.state),
+                      jax.tree.leaves(c.state)):
+        assert np.array_equal(np.asarray(pa), np.asarray(pc))
+
+
+def test_async_chaos_dropout_and_corruption_compose():
+    """Chaos composes with arrivals: dropout deterministically thins the
+    ingest stream (counted, replayable), NaN corruption rides an event
+    into the buffer and the robust aggregator survives it."""
+    def chaotic():
+        return _async_config(
+            fault_config={"dropout_rate": 0.3, "corrupt_rate": 0.15,
+                          "corrupt_mode": "nan", "seed": 11})
+
+    algo, rows = _run_rows(chaotic, 4)
+    assert rows[-1]["arrivals_dropped"] > 0
+    assert rows[-1]["fault_seed"] == 11
+    # Median over a partially-NaN buffer stays finite (robustness), and
+    # the realization replays identically (NaN == NaN: a corrupt
+    # event's NaN row makes the ALL-rows update_norm_mean NaN by
+    # design, exactly like the sync corruption path).
+    for r in rows:
+        assert np.isfinite(r["agg_norm"])
+    _, rows_b = _run_rows(chaotic, 4)
+    for ra, rb in zip(rows, rows_b):
+        for k in _REPLAYABLE:
+            assert _close_or_both_nan(ra[k], rb[k]), k
+    # The corruption stream actually fired somewhere in the window
+    # (deterministically — corrupt events are pure in (seed, tick,
+    # client)), visible as corrupted buffer rows: train_loss of a cycle
+    # with a corrupt benign event excludes it, so just pin determinism
+    # plus the dropout accounting above.
+    assert rows[-1]["arrivals_dropped"] == rows_b[-1]["arrivals_dropped"]
+
+
+@pytest.mark.slow  # two extra cycle compiles; tier-1 keeps the copy-mode zoo
+def test_async_lazy_replay_uses_stale_params():
+    """mode='replay' free-riders compute against the OLDEST retained
+    params version: with distinct history rows, a fresh (staleness-0)
+    malicious event's update changes while every benign event's stays
+    bit-identical — the substitution only an async server can express."""
+    from blades_tpu.adversaries import get_adversary, make_malicious_mask
+    from blades_tpu.arrivals.cycle import build_cycle, init_history
+    from blades_tpu.core import FedRound, Server, TaskSpec
+    from blades_tpu.models import MLP
+    from blades_tpu.utils.tree import ravel_fn
+
+    task = TaskSpec(model=MLP(hidden1=8, hidden2=8, num_classes=4),
+                    input_shape=(8, 8, 1), num_classes=4, lr=0.1).build()
+    server = Server.from_config(aggregator="Mean", lr=0.5)
+    H = 3
+
+    def make(adv):
+        fr = FedRound(task=task, server=server, adversary=adv,
+                      batch_size=4, num_batches_per_round=1)
+        cyc = build_cycle(fr, staleness_cap=H,
+                          weight_schedule="constant", weight_power=0.5,
+                          weight_cutoff=16)
+        state = fr.init(jax.random.PRNGKey(0), N)
+        hist = init_history(state.server.params, H)
+        # Distinct history rows: version j-ago params = init + 0.01*j.
+        hist = hist + 0.01 * jnp.arange(H + 1)[:, None]
+        import dataclasses as _dc
+
+        return cyc, _dc.replace(state, arrivals=hist)
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(N, 8, 8, 8, 1)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 4, size=(N, 8)), jnp.int32)
+    ln = jnp.full((N,), 8, jnp.int32)
+    ev_clients = jnp.asarray([0, 2, 3, 4], jnp.int32)  # client 0 malicious
+    ev_ticks = jnp.asarray([5, 5, 6, 6], jnp.int32)
+    ev_stale = jnp.zeros(4, jnp.int32)                 # all claim fresh
+    mal = np.asarray(make_malicious_mask(N, 1))
+    ev_mal = jnp.asarray(mal[np.asarray(ev_clients)])
+    ev_corr = jnp.zeros(4, bool)
+    kb = jax.random.PRNGKey(9)
+    ka = jax.random.PRNGKey(11)
+
+    lazy = get_adversary("Lazy", mode="replay", noise_std=0.0)
+    cyc_lazy, st = make(lazy)
+    cyc_honest, st2 = make(None)
+    _, m_lazy = cyc_lazy(st, x, y, ln, ev_clients, ev_ticks, ev_stale,
+                         ev_mal, ev_corr, kb, ka)
+    _, m_honest = cyc_honest(st2, x, y, ln, ev_clients, ev_ticks,
+                             ev_stale, ev_mal, ev_corr, kb, ka)
+    # The malicious event trained against hist[H] instead of hist[0]:
+    # the aggregate (Mean over the 4 rows) must differ.
+    assert float(m_lazy["agg_norm"]) != float(m_honest["agg_norm"])
+    # Sanity: with NO malicious event in the cycle the two programs are
+    # bit-identical (the override touches malicious lanes only).
+    ev_clients_b = jnp.asarray([2, 3, 4, 5], jnp.int32)
+    ev_mal_b = jnp.asarray(mal[np.asarray(ev_clients_b)])
+    _, mb_lazy = cyc_lazy(st, x, y, ln, ev_clients_b, ev_ticks, ev_stale,
+                          ev_mal_b, ev_corr, kb, ka)
+    _, mb_honest = cyc_honest(st2, x, y, ln, ev_clients_b, ev_ticks,
+                              ev_stale, ev_mal_b, ev_corr, kb, ka)
+    assert float(mb_lazy["agg_norm"]) == float(mb_honest["agg_norm"])
+
+
+@pytest.mark.parametrize("aggregator", [
+    {"type": "Median"},
+    # Budget convention: one aggregator headlines tier-1, the rest of
+    # the zoo (plus the CNN protocol below) rides the slow tier.
+    pytest.param({"type": "Multikrum", "k": 2}, marks=pytest.mark.slow),
+    pytest.param({"type": "GeoMed"}, marks=pytest.mark.slow),
+])
+def test_async_aggregator_zoo_with_lazy_clients(aggregator):
+    """≥3 robust aggregators under the lazy-client adversary on the
+    async path (the tiny-MLP slice of the acceptance protocol; the
+    32-client CNN version is the slow marker below).  agg_every=6:
+    f-dependent aggregators (Multikrum) see the BUFFER as their row
+    axis, so the 2f+2 <= K feasibility bound is a buffer-size bound
+    under async (documented in the README interaction matrix)."""
+    def build():
+        return _async_config(
+            aggregator=aggregator, num_malicious_clients=2,
+            adversary_config={"type": "Lazy", "mode": "copy"},
+            arrivals={"agg_every": 6})
+
+    _, rows = _run_rows(build, 3)
+    for r in rows:
+        assert np.isfinite(r["train_loss"]) and np.isfinite(r["agg_norm"])
+    assert rows[-1]["training_iteration"] == 3
+
+
+@pytest.mark.slow
+def test_async_cnn_protocol_acceptance():
+    """The acceptance protocol at full size: 32-client CNN, Poisson
+    arrivals, lazy free-riders, three robust aggregators."""
+    from blades_tpu.algorithms.config import FedavgConfig
+
+    for agg in ({"type": "Median"}, {"type": "Multikrum", "k": 8},
+                {"type": "GeoMed"}):
+        cfg = (FedavgConfig()
+               .data(dataset="cifar10", num_clients=32, seed=3)
+               .training(global_model="cnn", aggregator=agg,
+                         train_batch_size=8)
+               .adversary(num_malicious_clients=8,
+                          adversary_config={"type": "Lazy",
+                                            "mode": "replay"})
+               .resources(execution="async")
+               # agg_every=24: Multikrum's 2f+2 <= K bound at f=8.
+               .arrivals(rate=0.25, agg_every=24, staleness_cap=8))
+        algo = cfg.build()
+        rows = [algo.train() for _ in range(2)]
+        for r in rows:
+            assert np.isfinite(r["train_loss"])
+            assert np.isfinite(r["agg_norm"])
+            assert r["updates_per_sec"] > 0
+
+
+# ---------------------------------------------------------------------------
+# sync staleness stamps (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_sync_straggler_path_stamps_staleness():
+    from blades_tpu.algorithms.config import FedavgConfig
+
+    cfg = (FedavgConfig()
+           .data(dataset="mnist", num_clients=N, seed=7)
+           .training(global_model="mlp")
+           .fault_tolerance(faults={"num_stragglers": 2, "staleness": 3,
+                                    "seed": 5}))
+    algo = cfg.build()
+    rows = [algo.train() for _ in range(2)]
+    for r in rows:
+        assert r["staleness_max"] == 3  # 2 stragglers deliver 3-old work
+        want = 3.0 * r["num_straggled"] / r["num_participating"]
+        assert r["staleness_mean"] == pytest.approx(want)
+    # And a fault-free run stamps neither (schema stays lean).
+    clean = (FedavgConfig().data(dataset="mnist", num_clients=N, seed=7)
+             .training(global_model="mlp")).build()
+    row = clean.train()
+    assert "staleness_mean" not in row and "tick" not in row
+
+
+# ---------------------------------------------------------------------------
+# observability: schema, sweep, watchdog, replay
+# ---------------------------------------------------------------------------
+
+
+def test_async_sweep_schema_valid_rows_and_summary(tmp_path):
+    from blades_tpu.obs.schema import validate_jsonl
+    from blades_tpu.tune import run_experiments
+
+    experiments = {
+        "async_smoke": {
+            "run": "FEDAVG",
+            "stop": {"training_iteration": 4},
+            "config": {
+                "dataset_config": {"type": "mnist", "num_clients": N,
+                                   "train_bs": 8, "seed": 7},
+                "global_model": "mlp",
+                "evaluation_interval": 2,
+                "execution": "async",
+                "async_config": {"rate": 0.4, "agg_every": 4,
+                                 "staleness_cap": 4},
+            },
+        }
+    }
+    summaries = run_experiments(experiments, storage_path=str(tmp_path),
+                                verbose=0, watchdog=True)
+    (s,) = summaries
+    assert "status" not in s, s.get("error")
+    assert s["arrivals"]["tick"] > 0
+    assert "updates_per_sec" in s["arrivals"]
+    stream = Path(s["dir"]) / "metrics.jsonl"
+    num_valid, errors = validate_jsonl(stream)
+    assert errors == [] and num_valid == 4
+    rows = [json.loads(l) for l in stream.read_text().splitlines()]
+    ticks = [r["tick"] for r in rows]
+    assert ticks == sorted(ticks)
+    # The one front door agrees (tick order included).
+    from tools.validate_metrics import main as validate_main
+
+    assert validate_main([str(stream)]) == 0
+
+
+def test_validate_metrics_rejects_backwards_ticks(tmp_path, capsys):
+    from tools.validate_metrics import main as validate_main
+
+    p = tmp_path / "metrics.jsonl"
+    base = {"experiment": "e", "trial": "t"}
+    rows = [dict(base, training_iteration=1, tick=5),
+            dict(base, training_iteration=2, tick=3)]
+    p.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    assert validate_main([str(p)]) == 1
+    assert "tick went backwards" in capsys.readouterr().out
+
+
+def test_watchdog_staleness_and_ingest_rules():
+    from blades_tpu.obs.watchdog import Watchdog
+
+    wd = Watchdog()
+
+    def row(i, ups=100.0, smax=2):
+        return {"training_iteration": i, "train_loss": 1.0,
+                "agg_norm": 1.0, "update_norm_mean": 1.0,
+                "updates_per_sec": ups, "staleness_max": smax}
+
+    for i in range(1, 6):
+        assert wd.observe(row(i)) == []
+    ev = wd.observe(row(6, ups=10.0))  # 10 < 100/4 => ingest collapse
+    assert [e.rule for e in ev] == ["ingest_collapse"]
+    ev = wd.observe(row(7, smax=100))
+    assert [e.rule for e in ev] == ["staleness_runaway"]
+    # warm() replays the window without re-firing events.
+    wd2 = Watchdog()
+    wd2.warm([row(i) for i in range(1, 6)])
+    assert wd2.events == []
+    ev = wd2.observe(row(6, ups=10.0))
+    assert [e.rule for e in ev] == ["ingest_collapse"]
+
+
+def test_replay_rejects_ambiguous_duplicate_ticks():
+    """Cycles fired from leftover buffered events share a virtual tick;
+    --tick against a duplicated tick must error loudly (pointing at the
+    round index), never silently pick one of the rows."""
+    from tools.replay_round import replay
+
+    dump = {
+        "algo": "FEDAVG", "config": {}, "capacity": 4,
+        "rounds": [
+            {"training_iteration": 1, "tick": 7, "train_loss": 1.0},
+            {"training_iteration": 2, "tick": 7, "train_loss": 2.0},
+        ],
+    }
+    with pytest.raises(ValueError, match="matches 2 recorded rounds"):
+        replay(dump, tick=7)
+
+
+def test_async_cutoff_all_stale_batch_warns():
+    """An all-over-cutoff buffer is a zero-step cycle by contract — but
+    the host engine must say so loudly instead of silently stalling."""
+    def build():
+        return _async_config(
+            arrivals={"weight_schedule": "cutoff", "weight_cutoff": 0})
+
+    algo = build().build()
+    algo.train()  # cycle 1: staleness 0 everywhere, no warning
+    with pytest.warns(RuntimeWarning, match="fully discarded"):
+        row = algo.train()  # backlog => staleness >= 1 > cutoff=0
+    assert row["staleness_mean"] >= 1.0
+
+
+def test_flightrec_replay_async_round(tmp_path):
+    """tools/replay_round understands tick-indexed async rows: replay to
+    a recorded virtual tick reproduces the digest bit-identically."""
+    from blades_tpu.obs.flightrec import FlightRecorder
+    from tools.replay_round import main as replay_main
+
+    trial_cfg = {
+        "dataset_config": {"type": "mnist", "num_clients": N, "seed": 7},
+        "global_model": "mlp",
+        "execution": "async",
+        "async_config": {"rate": 0.4, "agg_every": 4, "staleness_cap": 4},
+    }
+    from blades_tpu.algorithms import get_algorithm_class
+
+    _, config = get_algorithm_class("FEDAVG", return_config=True)
+    config.update_from_dict(json.loads(json.dumps(trial_cfg)))
+    algo = config.build()
+    rec = FlightRecorder(tmp_path / "flightrec.json", capacity=8,
+                         experiment="e", trial="t", algo="FEDAVG",
+                         config=trial_cfg, max_rounds=3)
+    rows = [algo.train() for _ in range(3)]
+    for r in rows:
+        rec.record(json.loads(json.dumps(dict(r, trial="t"),
+                                         default=float)))
+    rec.dump({"kind": "exception", "round": rows[-1]["training_iteration"]})
+    # Replay by server round (the default trigger path)...
+    assert replay_main([str(tmp_path / "flightrec.json"), "--quiet"]) == 0
+    # ...and by the recorded VIRTUAL tick (async rows are tick-indexed).
+    vtick = rows[1]["tick"]
+    if vtick not in (r["training_iteration"] for r in rows):
+        assert replay_main([str(tmp_path / "flightrec.json"), "--quiet",
+                            "--tick", str(vtick)]) == 0

@@ -178,6 +178,26 @@ def test_trace_discipline_fixtures():
     assert run_fixture([tg], "tracediscipline_good.py") == []
 
 
+def test_arrival_purity_fixtures():
+    """ISSUE 14 fixture pair: arrival realizations must be pure in
+    (seed, tick) — a wall-clock-derived tick (or a raw-clock ingest
+    measurement) in an arrival process is exactly the trace-discipline
+    violation class, and the virtual-tick/\\ ``obs.trace.now()`` twin
+    stays silent."""
+    ap = TraceDisciplinePass(prefixes=[f"{FIX}/arrivalpurity_bad.py"])
+    bad = errors_of(run_fixture([ap], "arrivalpurity_bad.py"),
+                    "trace-discipline")
+    msgs = "\n".join(f.message for f in bad)
+    assert "time.time()" in msgs             # wall-clock tick derivation
+    assert "mono()" in msgs                  # aliased from-import form
+    assert "time.perf_counter()" in msgs     # raw ingest-rate measurement
+    assert len(bad) == 4
+    # Clean twin: the virtual tick counter and the sanctioned
+    # obs.trace.now() ingest measurement produce zero findings.
+    ag = TraceDisciplinePass(prefixes=[f"{FIX}/arrivalpurity_good.py"])
+    assert run_fixture([ag], "arrivalpurity_good.py") == []
+
+
 def test_trace_discipline_allows_timer_modules():
     """The span layer itself (and its shims) are the sanctioned homes of
     raw clock reads — the default-configured pass must skip them while

@@ -145,7 +145,8 @@ def test_watchdog_rules_are_schema_driven():
     # Every default rule names a registered field by construction.
     assert {r.name for r in default_rules()} == {
         "nan_aggregate", "nan_loss", "update_norm_spike",
-        "fpr_collapse", "round_time_regression"}
+        "fpr_collapse", "round_time_regression",
+        "staleness_runaway", "ingest_collapse"}
 
 
 def test_watchdog_nonfinite_spike_and_ceiling():

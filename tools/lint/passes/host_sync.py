@@ -33,6 +33,14 @@ DEVICE_SIDE = (
     "blades_tpu/adversaries/training_attacks.py",
     "blades_tpu/faults/injector.py",
     "blades_tpu/comm/codecs.py",
+    # Buffered-async subsystem (ISSUE 14): the cycle program and the
+    # realization/weight modules trace into the jitted cycle; the host
+    # engine (arrivals/engine.py) is deliberately NOT here — its
+    # device_get of the realization windows is the sanctioned host
+    # boundary.
+    "blades_tpu/arrivals/cycle.py",
+    "blades_tpu/arrivals/process.py",
+    "blades_tpu/arrivals/weights.py",
     "blades_tpu/ops/aggregators.py",
     "blades_tpu/ops/clustering.py",
     "blades_tpu/ops/layout.py",

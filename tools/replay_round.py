@@ -52,32 +52,64 @@ def bit_equal(a, b) -> bool:
 def replay(dump: dict, tick=None):
     """Re-run the dump's trajectory to ``tick``; returns
     ``(replayed row, recorded digest)``.  Raises ``ValueError`` when the
-    dump records nothing usable."""
+    dump records nothing usable.
+
+    Async rows (blades_tpu/arrivals) are TICK-indexed on top of
+    round-indexed: ``tick`` first matches a recorded row's
+    ``training_iteration`` (every execution path), then — async rows
+    only — a row's virtual arrival-clock ``tick`` field; either way the
+    replay re-runs server rounds to the matched row's
+    ``training_iteration`` (the virtual clock advances deterministically
+    alongside, so reaching the round IS reaching the recorded tick)."""
     from blades_tpu.algorithms import get_algorithm_class
 
     rounds = dump.get("rounds") or []
-    by_tick = {r.get("training_iteration"): r for r in rounds
+    by_iter = {r.get("training_iteration"): r for r in rounds
                if isinstance(r, dict)}
+    # Virtual-tick index: consecutive cycles CAN share a tick (a cycle
+    # fired from leftover buffered events does not advance the clock),
+    # so only unambiguous ticks resolve — a duplicated one is an
+    # explicit error pointing at the round index, never a silent
+    # pick-the-last.
+    vtick_rows: dict = {}
+    for r in rounds:
+        if isinstance(r, dict) and isinstance(r.get("tick"), int):
+            vtick_rows.setdefault(r["tick"], []).append(r)
+    by_vtick = {t: rs[0] for t, rs in vtick_rows.items() if len(rs) == 1}
     if tick is None:
         trig = dump.get("trigger") or {}
         tick = trig.get("round") or (dump.get("rng") or {}).get("tick")
-    if tick not in by_tick:
+    recorded = by_iter.get(tick)
+    if recorded is None and tick in vtick_rows and tick not in by_vtick:
+        raise ValueError(
+            f"virtual tick {tick} matches {len(vtick_rows[tick])} "
+            "recorded rounds "
+            f"{[r.get('training_iteration') for r in vtick_rows[tick]]} "
+            "(cycles fired from leftover buffered events share a tick) "
+            "— disambiguate with --tick <training_iteration>")
+    if recorded is None:
+        recorded = by_vtick.get(tick)
+    if recorded is None:
+        window = sorted(by_iter)
+        vwindow = sorted(by_vtick)
         raise ValueError(
             f"tick {tick!r} is not in the dump's recorded window "
-            f"{sorted(by_tick)} — the ring only holds the last "
+            f"(rounds {window}"
+            + (f", arrival ticks {vwindow}" if vwindow else "")
+            + f") — the ring only holds the last "
             f"{dump.get('capacity')} rounds")
-    recorded = by_tick[tick]
+    target = recorded["training_iteration"]
 
     _, config = get_algorithm_class(dump["algo"], return_config=True)
     config.update_from_dict(json.loads(json.dumps(dump.get("config", {}))))
     algo = config.build()
     row = None
-    while algo.iteration < tick:
+    while algo.iteration < target:
         row = algo.train()
-    if row is None or row.get("training_iteration") != tick:
+    if row is None or row.get("training_iteration") != target:
         raise ValueError(
             f"replay stopped at iteration {algo.iteration} "
-            f"(rounds_per_dispatch overshoots tick {tick}?)")
+            f"(rounds_per_dispatch overshoots round {target}?)")
     return row, recorded
 
 

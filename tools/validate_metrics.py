@@ -5,7 +5,12 @@ The PR-1 offline validator (``python -m blades_tpu.obs.schema``) grew
 two artifact classes in ISSUE 12; this CLI is the one front door:
 
 - default: ``metrics.jsonl`` streams against the round-record schema
-  (delegates to :func:`blades_tpu.obs.schema.validate_jsonl`);
+  (delegates to :func:`blades_tpu.obs.schema.validate_jsonl`), plus the
+  async-row ordering contract: rows stamped by the buffered-async path
+  (blades_tpu/arrivals) are TICK-indexed on top of round-indexed, and
+  the virtual arrival clock only moves forward — a ``tick`` that goes
+  backwards between consecutive records means interleaved or
+  re-ordered streams and is reported as an error;
 - ``--flightrec``: ``flightrec.json`` dumps
   (:func:`blades_tpu.obs.flightrec.validate_flightrec`);
 - ``--trace``: Chrome/Perfetto span-trace exports
@@ -34,6 +39,36 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parents[1]
 if str(REPO) not in sys.path:
     sys.path.insert(0, str(REPO))
+
+
+def _async_tick_errors(path):
+    """Tick-monotonicity over a metrics.jsonl stream: the virtual
+    arrival clock (async rows' ``tick``) must be non-decreasing in file
+    order.  Rows without a ``tick`` (synchronous trials) are ignored;
+    unparseable lines are the schema validator's findings, not ours."""
+    import json
+
+    errors = []
+    last = None
+    last_line = None
+    with open(path) as f:
+        for lineno, line in enumerate(f, start=1):
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            tick = rec.get("tick") if isinstance(rec, dict) else None
+            if not isinstance(tick, int) or isinstance(tick, bool):
+                continue
+            if last is not None and tick < last:
+                errors.append((lineno,
+                               f"async tick went backwards: {tick} after "
+                               f"{last} (line {last_line}) — the virtual "
+                               "arrival clock only moves forward"))
+            last, last_line = tick, lineno
+    return errors
 
 
 def _report(path, num_ok: int, what: str, errors) -> int:
@@ -87,6 +122,7 @@ def main(argv=None) -> int:
             from blades_tpu.obs.schema import validate_jsonl
 
             num, errors = validate_jsonl(path)
+            errors = list(errors) + _async_tick_errors(path)
             rc |= _report(path, num, "record(s)", errors)
     return rc
 
