@@ -25,7 +25,12 @@ Two measured workloads, one JSON line:
    ``BLADES_BENCH_ASYNC``: the same protocol under buffered-async
    execution — ``blades_tpu/arrivals`` — reporting the ingest metric
    ``updates_per_sec`` under a Poisson arrival process with Lazy
-   free-riders next to ``rounds_per_sec``, on both backends.)
+   free-riders next to ``rounds_per_sec``, on both backends.  And
+   env-gated ``BLADES_BENCH_OOC``: the same protocol with a
+   participation window — resident vs host out-of-core client-state
+   staging (``blades_tpu/state``) plus a large-n host-only point —
+   reporting staging telemetry next to the wall times, on both
+   backends.)
 2. **ResNet-18 @ 768 clients** (the model BASELINE.json actually names):
    768 is the single-chip capacity limit under malicious-lane elision —
    the benign-compacted bf16 update matrix stores 576 rows = 12.9 GB
@@ -912,6 +917,81 @@ def _async_block(cpu: bool) -> dict:
     return _measure_async_cnn(timed_cycles=timed)
 
 
+def _measure_ooc_round(backend: str, *, num_clients=32, window=8,
+                       num_byzantine=8, timed_rounds=3, model="cnn",
+                       dataset="cifar10", adversary="ALIE",
+                       momentum=0.9) -> dict:
+    """One arm of the BLADES_BENCH_OOC A/B (ISSUE 15): the 32-client
+    protocol through the FULL driver with a participation window —
+    per-round cohorts of ``window`` clients whose state rows live in
+    the ``backend`` store ("resident" keeps the population in HBM,
+    "host"/"disk" stage cohort rows through the prefetcher).  Client
+    momentum is ON so the per-client rows are real state, and the row
+    stamps report the staging telemetry next to the wall time."""
+    from blades_tpu.algorithms import FedavgConfig
+
+    cfg = (
+        FedavgConfig()
+        .data(dataset=dataset, num_clients=num_clients, seed=0)
+        .training(global_model=model, server_lr=0.5,
+                  train_batch_size=BATCH,
+                  num_batch_per_round=LOCAL_STEPS,
+                  aggregator={"type": "Median"})
+        .client(lr=0.1, momentum=momentum)
+        .adversary(num_malicious_clients=num_byzantine,
+                   adversary_config={"type": adversary})
+        .evaluation(evaluation_interval=0)
+        .resources(execution="dense", state_store=backend, window=window)
+    )
+    algo = cfg.build()
+    try:
+        row = algo.train()  # compile + settle outside the timed loop
+        t0 = time.perf_counter()
+        for _ in range(timed_rounds):
+            row = algo.train()
+        dt = time.perf_counter() - t0
+        final_loss = float(row["train_loss"])
+        assert final_loss == final_loss  # NaN guard
+        return {
+            "rounds_per_sec": round(timed_rounds / dt, 4),
+            "clients": num_clients, "window": window,
+            "byzantine": num_byzantine, "model": model,
+            "batch": BATCH, "local_steps": LOCAL_STEPS,
+            "timed_rounds": timed_rounds, "aggregator": "Median",
+            "adversary": adversary, "path": "windowed_dense",
+            "state_store": row.get("state_store", backend),
+            "state_stage_ms": row.get("state_stage_ms"),
+            "state_bytes_staged": row.get("state_bytes_staged"),
+            "state_peak_hbm_bytes": row.get("state_peak_hbm_bytes"),
+        }
+    finally:
+        algo.stop()
+
+
+def _ooc_block(cpu: bool) -> dict:
+    """BLADES_BENCH_OOC satellite (ISSUE 15): resident-vs-host A/B on
+    the 32-client windowed protocol — the staging overhead the
+    out-of-core store pays for its O(window) memory ceiling — plus a
+    large-n host-only point (a registered population whose resident
+    stack would dwarf the cohort working set).  Rides TPU main and
+    cpu_fallback; cpu_fallback numbers compare only with each other."""
+    timed = 2 if cpu else 3
+    resident = _measure_ooc_round("resident", timed_rounds=timed)
+    host = _measure_ooc_round("host", timed_rounds=timed)
+    out = {"resident": resident, "host": host}
+    if resident["rounds_per_sec"]:
+        out["host_over_resident"] = round(
+            host["rounds_per_sec"] / resident["rounds_per_sec"], 3)
+    # Large registered population, small cohort: the point the store
+    # exists for.  MLP keeps the compile/runtime affordable on the
+    # fallback box; the resident arm is deliberately absent (its stack
+    # is the memory ceiling being removed).
+    out["large_n_host"] = _measure_ooc_round(
+        "host", num_clients=2048, window=64, num_byzantine=512,
+        timed_rounds=max(1, timed - 1), model="mlp", dataset="mnist")
+    return out
+
+
 def _cpu_fallback(probe_err: str) -> None:
     """The relay-dead-box path: measure a REDUCED configuration of the
     same pipeline (FedAvg + ALIE forge + exact Median, dense round, CPU
@@ -990,6 +1070,14 @@ def _cpu_fallback(probe_err: str) -> None:
             out["async"] = _async_block(cpu=True)
         except Exception as e:
             out["async"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+    if os.environ.get("BLADES_BENCH_OOC", "1") == "1":
+        try:
+            # Out-of-core client state (ISSUE 15) on the reduced CPU
+            # config — resident vs host participation-window staging,
+            # plus the large-n host-only point.
+            out["ooc"] = _ooc_block(cpu=True)
+        except Exception as e:
+            out["ooc"] = {"error": f"{type(e).__name__}: {e}"[:300]}
     _emit(out)
 
 
@@ -1109,6 +1197,16 @@ def main() -> None:
             out["async"] = _async_block(cpu=False)
         except Exception as e:
             out["async"] = {"error": f"{type(e).__name__}: {e}"[:500]}
+
+    if os.environ.get("BLADES_BENCH_OOC", "1") == "1":
+        try:
+            # Out-of-core client state (ISSUE 15): resident vs host
+            # participation-window staging on the 32-client protocol,
+            # plus a large-n host-only point — the staging overhead
+            # paid for the O(window) per-client-state memory ceiling.
+            out["ooc"] = _ooc_block(cpu=False)
+        except Exception as e:
+            out["ooc"] = {"error": f"{type(e).__name__}: {e}"[:500]}
 
     _emit(out)
 

@@ -147,6 +147,31 @@ class FedavgConfig:
         # unpacked to the dense (n, d) matrix before forging/codecs/
         # faults/aggregation, and checkpoints stay layout-free.
         self.client_packing: Any = "off"
+        # Out-of-core per-client state (blades_tpu/state): where the
+        # persistent per-client rows (optimizer state, codec EF
+        # residual) live.  "resident" (default) = today's dense device
+        # stack — with state_window=None the round program, pytrees and
+        # checkpoints are LITERALLY unchanged.  "host"/"disk" require a
+        # participation window (state_window >= 1): only the sampled
+        # cohort's rows are device-resident each round; the registered-
+        # population remainder lives in pinned host arrays / a sharded
+        # memory-mapped store, with the next cohort staged while the
+        # current round computes.  All three backends are bit-identical
+        # for the same (seed, cohort schedule).
+        self.state_store: str = "resident"
+        # Participation window: clients sampled (without replacement,
+        # pure in the round key) into each round's cohort.  None = full
+        # participation with resident stacks (the pre-window program);
+        # 0 = STATELESS clients (full participation, per-client
+        # optimizer state re-initialized every round — the degenerate
+        # case where there is nothing to store); >= 1 = windowed cohort
+        # execution (dense single-chip only).  Set via
+        # .resources(window=...).
+        self.state_window: Optional[int] = None
+        # Directory for the "disk" backend's live sharded memmaps
+        # (None = a private temp dir, removed when the trial stops).
+        # Checkpoints stream their own per-shard files either way.
+        self.state_dir: Optional[str] = None
         # failure detection / elastic recovery (core/health.py): zero
         # non-finite client lanes, skip non-finite server updates
         self.health_check: bool = False
@@ -281,7 +306,17 @@ class FedavgConfig:
     def resources(self, *, num_devices=None, execution=None, client_block=None,
                   d_chunk=None, update_dtype=None, compute_dtype=None,
                   client_packing=None, mxu_finish=None, autotune=None,
-                  autotune_cache_dir=None, tuned_plan=None):
+                  autotune_cache_dir=None, tuned_plan=None,
+                  state_store=None, window=None, state_dir=None):
+        """``state_store=`` / ``window=`` / ``state_dir=`` configure the
+        out-of-core participation-window store (blades_tpu/state):
+        ``window`` is the per-round cohort size (``0`` = stateless
+        clients, the degenerate case), ``state_store`` where the
+        off-cohort rows live (``resident`` | ``host`` | ``disk``).
+        ``window=0`` must be passed explicitly — ``_set`` drops
+        ``None`` kwargs, so the sentinel distinction is deliberate."""
+        if window is not None:
+            self._set(state_window=int(window))
         return self._set(num_devices=num_devices, execution=execution,
                          client_block=client_block, d_chunk=d_chunk,
                          update_dtype=update_dtype,
@@ -289,7 +324,8 @@ class FedavgConfig:
                          client_packing=client_packing,
                          mxu_finish=mxu_finish, autotune=autotune,
                          autotune_cache_dir=autotune_cache_dir,
-                         tuned_plan=tuned_plan)
+                         tuned_plan=tuned_plan, state_store=state_store,
+                         state_dir=state_dir)
 
     def fault_tolerance(self, *, health_check=None, faults=None):
         """In-round failure detection / elastic recovery (core/health.py)
@@ -600,6 +636,88 @@ class FedavgConfig:
                     f"{sorted(c.__name__ for c in WIRE_AGGREGATORS)}); "
                     "use agg_domain='f32'"
                 )
+        # Out-of-core participation-window store (blades_tpu/state):
+        # every structural impossibility fails here, never at trace
+        # time — the faults/codecs fail-fast discipline.
+        from blades_tpu.state.store import STORE_BACKENDS
+
+        if self.state_store not in STORE_BACKENDS:
+            raise ValueError(
+                f"state_store must be one of {STORE_BACKENDS}, got "
+                f"{self.state_store!r}")
+        w = self.state_window
+        if w is not None and (not isinstance(w, int) or w < 0):
+            raise ValueError(
+                f"state_window must be None, 0 (stateless) or a positive "
+                f"cohort size, got {w!r}")
+        if w is None and self.state_store != "resident":
+            if self.execution != "async":
+                raise ValueError(
+                    f"state_store={self.state_store!r} needs a "
+                    "participation window: set .resources(window=...) — "
+                    "without one there is no cohort to stage (the async "
+                    "path alone windows by its event batch instead)")
+        if w == 0:
+            if self.state_store != "resident":
+                raise ValueError(
+                    "window=0 is the STATELESS degenerate case — clients "
+                    "keep no state, so there is nothing for a "
+                    f"{self.state_store!r} store to hold; drop "
+                    "state_store or use window >= 1")
+            codec = self.get_codec()
+            if codec is not None and codec.needs_residual:
+                raise ValueError(
+                    "window=0 (stateless clients) cannot compose with a "
+                    "top-k error-feedback codec: the EF residual is "
+                    "persistent per-client state by definition")
+            if self.execution not in ("auto", "dense"):
+                raise ValueError(
+                    "window=0 (stateless clients) is formulated for the "
+                    f"dense round only; execution={self.execution!r} "
+                    "carries its own per-client state threading")
+            if self.num_devices and self.num_devices > 1:
+                raise ValueError(
+                    "window=0 (stateless clients) is single-chip for "
+                    "now: the width-sharded round 'auto' may pick on a "
+                    "mesh threads per-client state through its own "
+                    "body — run without num_devices or drop window=0")
+        if w is not None and w >= 1:
+            if w > self.num_clients:
+                raise ValueError(
+                    f"window={w} > num_clients={self.num_clients}: the "
+                    "cohort samples without replacement from the "
+                    "registered population")
+            if self.execution not in ("auto", "dense"):
+                raise ValueError(
+                    "the participation-window store is formulated for "
+                    "the dense single-chip round (the cohort matrix is "
+                    f"(window, d)); execution={self.execution!r} has no "
+                    "windowed formulation — drop the window or use "
+                    "execution='dense'")
+            if self.num_devices and self.num_devices > 1:
+                raise ValueError(
+                    "the participation-window store is single-chip for "
+                    "now: cohort gather/scatter has no mesh formulation "
+                    "— run without num_devices or drop the window")
+            for knob, why in (
+                (self.forensics, "defense forensics (per-lane vectors "
+                 "would be indexed by a round-varying cohort)"),
+                (self.fault_config, "fault injection (the straggler "
+                 "ring and participation mask are keyed by lane, not "
+                 "registered id)"),
+                (self.client_packing not in ("off", None),
+                 "client lane-packing"),
+                (self.agg_domain != "f32", "wire-domain aggregation"),
+                (int(self.rounds_per_dispatch or 1) != 1,
+                 "rounds_per_dispatch > 1 (cohort staging happens "
+                 "between dispatches)"),
+                (self.chained_dispatch, "chained_dispatch"),
+            ):
+                if knob:
+                    raise ValueError(
+                        f"state_window={w} cannot compose with {why} "
+                        "yet — drop the feature or run without the "
+                        "participation window")
         if self.client_packing not in ("off", "auto", None):
             # Forced int P: structural impossibilities fail at validate()
             # time, the same fail-fast discipline as faults/codecs.  The
@@ -832,6 +950,9 @@ class FedavgConfig:
             codec=self.get_codec(),
             agg_domain=self.agg_domain,
             agg_d_chunk=self.d_chunk,
+            # window=0 stateless degenerate case (blades_tpu/state):
+            # fresh per-client optimizer state every round.
+            stateless_clients=self.state_window == 0,
         )
         # Client lane-packing: resolve "auto"/forced requests against the
         # built model (width heuristic, hook gates) — LOUD fallback under

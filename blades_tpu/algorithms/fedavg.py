@@ -57,13 +57,38 @@ class Fedavg:
                                              cfg.num_malicious_clients)
         self._key = jax.random.PRNGKey(cfg.seed)
         init_key, self._key = jax.random.split(self._key)
-        self.state = self.fed_round.init(init_key, cfg.num_clients)
+        # Out-of-core per-client state (blades_tpu/state): a sync
+        # participation window (state_window >= 1), or an async run
+        # whose event-cohort opt rows live behind a host/disk store.
+        # Either way the per-client stacks must NOT be materialised at
+        # init — at the registered populations the store exists for, a
+        # dense broadcast would OOM before the store could help.
+        sw = getattr(cfg, "state_window", None)
+        self._windowed = sw is not None and sw >= 1
+        ooc_async = (cfg.execution == "async"
+                     and cfg.state_store != "resident")
+        self._state_store = None   # ClientStateStore handle (None = off)
+        self._state_pf = None      # StatePrefetcher (sync windowed only)
+        self._window_prev = None   # (cohort ids, device rows) of round r-1
+        self._row_template = None  # one client's persistent-state row
+        if self._windowed or ooc_async:
+            server_rows = (int(sw) if self._windowed
+                           else cfg.get_async_spec().agg_every)
+            self.state, self._row_template = self.fed_round.init_windowed(
+                init_key, server_rows)
+        else:
+            self.state = self.fed_round.init(init_key, cfg.num_clients)
 
-        self._train_arrays = (
-            jnp.asarray(self.dataset.train.x),
-            jnp.asarray(self.dataset.train.y),
-            jnp.asarray(self.dataset.train.lengths),
-        )
+        # The windowed/out-of-core paths keep the training shards
+        # HOST-resident (cohort rows are gathered per round); every
+        # other path stages the full stacks onto the device as before.
+        self._host_train = (self.dataset.train.x, self.dataset.train.y,
+                            self.dataset.train.lengths)
+        if self._windowed or ooc_async:
+            self._train_arrays = None
+        else:
+            self._train_arrays = tuple(jnp.asarray(a)
+                                       for a in self._host_train)
         tx = jnp.asarray(self.dataset.test.x)
         ty = jnp.asarray(self.dataset.test.y)
         tln = jnp.asarray(self.dataset.test.lengths)
@@ -125,16 +150,31 @@ class Fedavg:
             # version they actually pulled.
             from blades_tpu.arrivals import AsyncEngine
 
+            if ooc_async:
+                # The event cohort's opt rows come from the window
+                # store (gathered per cycle, scattered back after);
+                # the version vector is already keyed by registered id.
+                from blades_tpu.state import make_store
+
+                self._state_store = make_store(
+                    cfg.state_store, cfg.num_clients, self._row_template,
+                    directory=getattr(cfg, "state_dir", None))
+                # Host-resident shards: the engine gathers the event
+                # cohort's data rows per cycle.
+                self._train_arrays = self._host_train
             self._async = AsyncEngine(
                 self.fed_round, cfg.get_async_spec(), cfg.num_clients,
                 train_seed=int(cfg.seed),
                 fault_injector=cfg.get_fault_injector(),
+                state_store=self._state_store,
             )
             self.state = _dc_replace(
                 self.state,
                 arrivals=self._async.init_history(self.state.server.params))
             self._step = None
             self._evaluate = jax.jit(self.fed_round.evaluate)
+        elif self._windowed:
+            self._setup_windowed_pipeline()
         elif cfg.num_devices and cfg.num_devices > 1:
             from blades_tpu.parallel import make_mesh, shard_federation, sharded_step
             from blades_tpu.parallel.sharded import sharded_evaluate, sharded_multi_step
@@ -313,6 +353,42 @@ class Fedavg:
             # the executable cannot be safely shared across trials, but
             # donation still applies per-trial.
             self._step = jax.jit(step_fn, donate_argnums=donate)
+            self._evaluate = jax.jit(self.fed_round.evaluate)
+
+    def _setup_windowed_pipeline(self) -> None:
+        """Single-chip participation-window path (blades_tpu/state):
+        each round gathers the sampled cohort's state/data rows from
+        the store, runs the SAME fused round program the dense path
+        jits (at cohort geometry, AOT-cached + donated), and scatters
+        the updated rows back; a :class:`~blades_tpu.state.prefetch.
+        StatePrefetcher` stages round ``r+1``'s cohort while round
+        ``r`` computes (``prefetch`` semantics as on the dense path:
+        "auto" = on for accelerator backends, forced either way is
+        bit-transparent)."""
+        from blades_tpu.perf import cached_jit
+        from blades_tpu.state import StatePrefetcher, make_store, sample_cohort
+
+        cfg = self.config
+        n, w = cfg.num_clients, int(cfg.state_window)
+        self._state_store = make_store(
+            cfg.state_store, n, self._row_template,
+            directory=getattr(cfg, "state_dir", None))
+        self._state_pf = StatePrefetcher(
+            self._state_store, self._host_train, np.asarray(self.malicious),
+            lambda k: sample_cohort(k, n, w),
+            async_staging=self._resolve_prefetch(),
+        )
+        donate = (0,) if getattr(cfg, "donate_buffers", True) else ()
+        fp = self._program_fingerprint()
+        if fp:
+            self._step = cached_jit(self.fed_round.step,
+                                    key=("step", "windowed", fp),
+                                    donate_argnums=donate)
+            self._evaluate = cached_jit(self.fed_round.evaluate,
+                                        key=("evaluate", fp))
+            self._cache_wrappers = [self._step, self._evaluate]
+        else:
+            self._step = jax.jit(self.fed_round.step, donate_argnums=donate)
             self._evaluate = jax.jit(self.fed_round.evaluate)
 
     def _resolve_prefetch(self) -> bool:
@@ -496,6 +572,17 @@ class Fedavg:
             return False
         if cfg.execution == "streamed":
             return True
+        if getattr(self, "_windowed", False):
+            # Participation-window runs compute over the (window, d)
+            # cohort matrix — the registered population never strains
+            # HBM, so 'auto' must not stream on its account.
+            return False
+        if getattr(self.fed_round, "stateless_clients", False):
+            # window=0 stateless clients are formulated in
+            # step_prebatched; the streamed path threads client_opt
+            # through its own block loop and would silently train
+            # STATEFUL clients — 'auto' must stay dense.
+            return False
         if not self._streamed_supported():
             return False
         return self._dense_matrix_bytes() > self.dense_matrix_hbm_limit()
@@ -547,8 +634,10 @@ class Fedavg:
         cfg = self.config
         explicit = getattr(cfg, "_explicit", set()) or set()
         baseline_streamed = self._use_streamed()
+        windowed = getattr(self, "_windowed", False)
+        stateless = getattr(self.fed_round, "stateless_clients", False)
         dense_features = (cfg.forensics or cfg.fault_config
-                          or cfg.codec_config)
+                          or cfg.codec_config or windowed or stateless)
         packing = getattr(self.fed_round, "packing", None)
         base_pack = int(packing.pack) if packing is not None else 1
 
@@ -608,7 +697,7 @@ class Fedavg:
         # pins too — only "auto" (a standing request to resolve) or the
         # untouched default may be varied.
         packs = [base_pack]
-        if (allow_reassociating and "dense" in execs
+        if (allow_reassociating and "dense" in execs and not windowed
                 and not isinstance(cfg.client_packing, int)
                 and (cfg.client_packing == "auto"
                      or "client_packing" not in explicit)):
@@ -659,7 +748,7 @@ class Fedavg:
         # configured.  Explicit agg_domain pins the list — the standard
         # composition contract.
         agg_domains = [cfg.agg_domain]
-        if (allow_reassociating and "dense" in execs
+        if (allow_reassociating and "dense" in execs and not windowed
                 and "agg_domain" not in explicit
                 and cfg.agg_domain == "f32" and cfg.codec_config
                 and not (cfg.fault_config or cfg.health_check
@@ -673,10 +762,28 @@ class Fedavg:
                                    WIRE_AGGREGATORS)):
                 agg_domains.append("wire")
 
+        # Participation-window store knobs (blades_tpu/state): the
+        # window size is PINNED (varying it changes which cohorts — and
+        # therefore which data — each round trains on; that is a
+        # different experiment, not a reassociation, and a speed-only
+        # tuner would always shrink it).  The store BACKEND is
+        # bit-identical by contract but changes the staging pipeline,
+        # so the reassociating tier may probe the alternates when the
+        # user left it defaulted; an explicit backend pins the list —
+        # the standard composition contract.
+        state_stores = [cfg.state_store]
+        if (allow_reassociating and windowed
+                and "state_store" not in explicit):
+            for alt in ("host", "resident"):
+                if alt not in state_stores:
+                    state_stores.append(alt)
+        state_windows = [getattr(cfg, "state_window", None)]
+
         return at.enumerate_plans(
             executions=execs, d_chunks=d_chunks, mxu_modes=mxu_modes,
             pack_factors=packs, scan_windows=windows,
             prefetch_options=prefetch_options, agg_domains=agg_domains,
+            state_stores=state_stores, state_windows=state_windows,
             allow_reassociating=allow_reassociating,
         )
 
@@ -835,12 +942,57 @@ class Fedavg:
         return self._plan_provenance
 
     @property
+    def state_summary(self) -> Optional[Dict]:
+        """Out-of-core client-state digest for sweep summaries (backend,
+        window, row/total bytes, staging peak), or ``None`` when no
+        store is configured."""
+        if self._state_store is None:
+            return None
+        stats = (self._state_pf.stats if self._state_pf is not None
+                 else self._async.store_stats)
+        return {
+            "backend": self._state_store.backend,
+            "window": (int(self.config.state_window)
+                       if self._state_pf is not None
+                       else int(self._async.spec.agg_every)),
+            "n_registered": self._state_store.n_registered,
+            "row_bytes": int(self._state_store.row_bytes),
+            "total_bytes": int(self._state_store.total_bytes()),
+            "peak_hbm_bytes": int(stats.peak_hbm_bytes),
+        }
+
+    @property
     def packing_summary(self) -> Optional[Dict]:
         """The lane-packing decision get_fed_round() resolved for this
         trial (requested/pack_factor/packed_lanes/fallback reason), or
         None when packing was never requested — the sweep mirrors it
         into trial summaries."""
         return getattr(self.config, "_packing_decision", None)
+
+    def _windowed_round(self):
+        """One participation-window round: take the staged cohort
+        (state rows, data shards, malicious mask), run the fused round
+        program over it, then hand the updated rows to the prefetcher
+        — the NEXT round's stage job first (it excludes this cohort's
+        ids, so it overlaps this round's compute), the write-back
+        second (FIFO ordering guarantees any later stage revisiting
+        these ids sees it).  Returns the device metrics dict."""
+        round_key, self._key = jax.random.split(self._key)
+        ids, rows, data, mal = self._state_pf.take(
+            self._iteration, round_key, self._window_prev)
+        state_in = _dc_replace(
+            self.state, client_opt=rows["client_opt"],
+            residual=rows.get("residual"), cohort=jnp.asarray(ids))
+        new_state, raw_metrics = self._step(state_in, *data, mal, round_key)
+        self.state = new_state
+        out_rows = {"client_opt": new_state.client_opt}
+        if new_state.residual is not None:
+            out_rows["residual"] = new_state.residual
+        self._state_pf.stage(self._iteration + 1,
+                             jax.random.split(self._key)[0], prev_ids=ids)
+        self._state_pf.writeback(ids, out_rows)
+        self._window_prev = (ids, out_rows)
+        return raw_metrics
 
     def train(self) -> Dict:
         """One training dispatch (= ``rounds_per_dispatch`` FL rounds, 1 by
@@ -868,6 +1020,8 @@ class Fedavg:
                 # checkpointed tick alone.
                 self.state, raw_metrics = self._async.run_cycle(
                     self.state, self._train_arrays, self.malicious)
+            elif self._state_pf is not None:
+                raw_metrics = self._windowed_round()
             elif self._chained:
                 # The window program advances the key chain itself, one
                 # split per scanned round — handing back the carry a
@@ -922,6 +1076,23 @@ class Fedavg:
             row["buffer_overflow"] = int(info["buffer_overflow"])
             row["arrival_seed"] = int(info["arrival_seed"])
             row["updates_per_sec"] = round(info["events"] / elapsed, 3)
+        if self._state_store is not None:
+            # Participation-window staging digest (blades_tpu/state):
+            # host counters the staging layer already holds — no device
+            # fetch to defer.  state_peak_hbm_bytes is the analytic
+            # ceiling on device-resident per-client state (store-held
+            # bytes + the staged/live/write-back cohort slots) — the
+            # number the memory-ceiling acceptance test pins against a
+            # window-proportional bound.
+            stats = (self._state_pf.stats if self._state_pf is not None
+                     else self._async.store_stats)
+            row["state_store"] = self._state_store.backend
+            row["cohort_size"] = (int(self.config.state_window)
+                                  if self._state_pf is not None
+                                  else int(self._async.spec.agg_every))
+            row["state_stage_ms"] = round(stats.last_stage_ms, 3)
+            row["state_bytes_staged"] = int(stats.last_bytes_staged)
+            row["state_peak_hbm_bytes"] = int(stats.peak_hbm_bytes)
         if self._cache_wrappers:
             # Per-trial AOT compile-cache counters (obs schema fields):
             # cumulative over this trial's dispatches, so the first row
@@ -980,9 +1151,13 @@ class Fedavg:
         codec = self.fed_round.codec  # comm subsystem (blades_tpu/comm)
         if codec is not None:
             # Static per-round byte accounting, stamped host-side so the
-            # device program carries no extra outputs.
-            row.update(codec.round_metrics(self.config.num_clients,
-                                           self._num_params))
+            # device program carries no extra outputs.  Under a
+            # participation window only the sampled cohort transmits —
+            # the uplink is window rows, not the registered population.
+            uplink_rows = (int(self.config.state_window)
+                           if self._state_pf is not None
+                           else self.config.num_clients)
+            row.update(codec.round_metrics(uplink_rows, self._num_params))
             # Aggregation-domain provenance (wire-domain aggregation):
             # which domain the defenses ran in and the storage width of
             # the matrix they traversed (8 = packed int8 wire payload,
@@ -1151,11 +1326,33 @@ class Fedavg:
     def save_checkpoint(self, checkpoint_dir: str) -> str:
         path = Path(checkpoint_dir)
         path.mkdir(parents=True, exist_ok=True)
+        state_for_pickle = self.state
+        if self._state_store is not None:
+            # Out-of-core per-client state: drain pending write-backs so
+            # the store is authoritative, then checkpoint it as
+            # STREAMING per-shard files (ClientStateStore.save — atomic
+            # per shard, bounded memory at any population size) instead
+            # of pickling the stacks.  The pickled RoundState carries
+            # the replicated server only; the disposable cohort copy is
+            # reconstructed from the store on resume.
+            if self._state_pf is not None:
+                self._state_pf.flush()
+            state_for_pickle = _dc_replace(
+                self.state, client_opt=None, residual=None, cohort=None)
         payload = {
             "iteration": self._iteration,
             "rounds_since_eval": self._rounds_since_eval,
             "key": jax.device_get(self._key),
-            "state": jax.device_get(self.state),
+            "state": jax.device_get(state_for_pickle),
+            # Participation-window store provenance (blades_tpu/state):
+            # present iff the per-client rows live in the sharded
+            # `client_state/` checkpoint next to this pickle.
+            "state_store": ({
+                "backend": self._state_store.backend,
+                "window": (int(self.config.state_window)
+                           if self._state_pf is not None else None),
+                "n_registered": self.config.num_clients,
+            } if self._state_store is not None else None),
             # Which client sits in each stacked row (the d-sharded
             # elision layout permutes clients at setup): lets a resume
             # under a DIFFERENT execution mode realign per-client state
@@ -1193,6 +1390,8 @@ class Fedavg:
         file = path / "algorithm_state.pkl"
         with open(file, "wb") as f:
             pickle.dump(payload, f)
+        if self._state_store is not None:
+            self._state_store.save(path / "client_state")
         return str(file)
 
     def load_checkpoint(self, checkpoint_path: str) -> None:
@@ -1254,8 +1453,74 @@ class Fedavg:
             )
         import dataclasses as _dc
 
+        saved_store = payload.get("state_store")
+        if self._state_store is not None:
+            ckpt_dir = p.parent
+            if saved_store:
+                # Streaming shard restore: validates per-shard sizes +
+                # CRCs, deletes orphaned .tmp files, fails fast on a
+                # torn/corrupt shard (StateStoreError).
+                self._state_store.load(ckpt_dir / "client_state")
+            elif getattr(state, "client_opt", None) is not None:
+                # Monolithic (pre-window / resident-stack) checkpoint
+                # resumed under a windowed store: scatter the stacks in.
+                rows = {"client_opt": state.client_opt}
+                if "residual" in (self._row_template or {}):
+                    res = getattr(state, "residual", None)
+                    if res is None:
+                        # No EF residual in the checkpoint: the store
+                        # keeps its cold zeros (the codec cold-start
+                        # discipline).
+                        rows = {"client_opt": state.client_opt,
+                                "residual": np.zeros(
+                                    (self.config.num_clients,)
+                                    + tuple(self._row_template[
+                                        "residual"].shape),
+                                    np.float32)}
+                    else:
+                        rows["residual"] = res
+                self._state_store.scatter(
+                    np.arange(self.config.num_clients), rows)
+                warnings.warn(
+                    "resumed a monolithic checkpoint under a windowed "
+                    "state store: per-client rows were scattered into "
+                    "the store, but the saved aggregator state was "
+                    "sized for the full population — stateful "
+                    "aggregators may not restore cleanly",
+                    RuntimeWarning, stacklevel=2)
+            state = _dc.replace(state, client_opt=None, residual=None,
+                                cohort=None)
+            self._window_prev = None
+            if self._state_pf is not None:
+                self._state_pf.invalidate()
+        elif saved_store:
+            # Windowed-store checkpoint resumed on the resident path:
+            # materialise the stacks from the shard files (same
+            # size/CRC validation as the windowed restore).
+            from blades_tpu.state import (client_state_template,
+                                          read_checkpoint_rows)
+
+            template = client_state_template(self.fed_round,
+                                             state.server.params)
+            rows = read_checkpoint_rows(p.parent / "client_state",
+                                        template, self.config.num_clients)
+            state = _dc.replace(
+                state,
+                client_opt=jax.tree.map(jnp.asarray, rows["client_opt"]),
+                residual=(jnp.asarray(rows["residual"])
+                          if "residual" in rows
+                          else getattr(state, "residual", None)),
+                cohort=None)
+            warnings.warn(
+                "resumed a windowed-store checkpoint on the resident "
+                "path: per-client stacks were rebuilt from the shard "
+                "files, but the saved aggregator state was sized for "
+                "the window — stateful aggregators may not restore "
+                "cleanly", RuntimeWarning, stacklevel=2)
+
         faults = self.fed_round.faults
-        if (faults is not None and faults.needs_stale_buffer
+        if (self._state_store is None and faults is not None
+                and faults.needs_stale_buffer
                 and getattr(state, "stale", None) is None):
             # Checkpoint from a run without a straggler process resumed
             # under one: start the ring buffer cold (zeros), exactly like
@@ -1265,7 +1530,8 @@ class Fedavg:
             _, _, d = ravel_fn(state.server.params)
             state = _dc.replace(state, stale=faults.init_stale_buffer(n, d))
         codec = self.fed_round.codec
-        if (codec is not None and codec.needs_residual
+        if (self._state_store is None and codec is not None
+                and codec.needs_residual
                 and getattr(state, "residual", None) is None):
             # Checkpoint from a run without error feedback resumed under
             # a top-k+EF codec: start the residual cold (zeros), exactly
@@ -1311,4 +1577,7 @@ class Fedavg:
     # -- misc ---------------------------------------------------------------
 
     def stop(self) -> None:
-        pass
+        if self._state_pf is not None:
+            self._state_pf.close()
+        if self._state_store is not None:
+            self._state_store.close()

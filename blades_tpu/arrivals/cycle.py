@@ -65,7 +65,7 @@ def init_history(params, staleness_cap: int) -> jax.Array:
 
 def build_cycle(fed_round, *, staleness_cap: int, weight_schedule: str,
                 weight_power: float, weight_cutoff: int,
-                corrupt_mode=None):
+                corrupt_mode=None, windowed_state: bool = False):
     """Build the pure cycle function for ``fed_round`` (jit the result).
 
     Returns ``cycle(state, data_x, data_y, lengths, ev_clients,
@@ -73,6 +73,18 @@ def build_cycle(fed_round, *, staleness_cap: int, weight_schedule: str,
     (new_state, metrics)`` where the ``ev_*`` arrays are the host
     engine's ``(K,)`` event columns.  ``state.arrivals`` must carry the
     ``(H+1, d)`` params-history ring (:func:`init_history`).
+
+    ``windowed_state=True`` is the out-of-core composition
+    (blades_tpu/state): the registered population's opt rows live in a
+    host/disk :class:`~blades_tpu.state.store.ClientStateStore`, so
+    the cycle receives the EVENT COHORT's rows directly —
+    ``state.client_opt`` is the ``(K, ...)`` gathered stack and
+    ``data_x``/``data_y``/``lengths`` are the ``(K, ...)`` event
+    shards the engine gathered host-side — and returns the updated
+    cohort stack for the engine to scatter back, instead of indexing/
+    updating a full ``(n, ...)`` device stack in the traced program.
+    The gathered rows are bit-equal to what the resident indexing
+    reads, so both modes produce identical cycles.
     """
     task = fed_round.task
     hooks = fed_round._hooks()
@@ -111,10 +123,15 @@ def build_cycle(fed_round, *, staleness_cap: int, weight_schedule: str,
                 idx = jnp.where(ev_malicious, staleness_cap, idx)
             params_vecs = hist[idx]  # (K, d) per-event params versions
 
-        ex = data_x[ev_clients]
-        ey = data_y[ev_clients]
-        eln = lengths[ev_clients]
-        opt_sel = jax.tree.map(lambda a: a[ev_clients], state.client_opt)
+        if windowed_state:
+            ex, ey, eln = data_x, data_y, lengths
+            opt_sel = state.client_opt
+        else:
+            ex = data_x[ev_clients]
+            ey = data_y[ev_clients]
+            eln = lengths[ev_clients]
+            opt_sel = jax.tree.map(lambda a: a[ev_clients],
+                                   state.client_opt)
 
         def one_event(pvec, opt, cx, cy, ln, tick, client, mal):
             ek = event_train_key(key_base, tick, client)
@@ -160,10 +177,13 @@ def build_cycle(fed_round, *, staleness_cap: int, weight_schedule: str,
         ravel, _, _ = ravel_fn(server.params)
         hist = jnp.concatenate([ravel(server.params)[None], hist[:-1]],
                                axis=0)
-        client_opt = jax.tree.map(
-            lambda full, upd: full.at[ev_clients].set(upd),
-            state.client_opt, new_opt,
-        )
+        if windowed_state:
+            client_opt = new_opt  # (K, ...): the engine scatters it back
+        else:
+            client_opt = jax.tree.map(
+                lambda full, upd: full.at[ev_clients].set(upd),
+                state.client_opt, new_opt,
+            )
         benign = ((~ev_malicious) & (~ev_corrupt)).astype(jnp.float32)
         train_loss = (losses * benign).sum() / jnp.maximum(benign.sum(), 1.0)
         metrics = {
@@ -179,6 +199,7 @@ def build_cycle(fed_round, *, staleness_cap: int, weight_schedule: str,
             stale=getattr(state, "stale", None),
             residual=getattr(state, "residual", None),
             arrivals=hist,
+            cohort=getattr(state, "cohort", None),
         ), metrics
 
     return cycle

@@ -130,7 +130,7 @@ class AsyncEngine:
     """Host driver pairing an :class:`AsyncSpec` with a ``FedRound``."""
 
     def __init__(self, fed_round, spec: AsyncSpec, num_clients: int, *,
-                 train_seed: int, fault_injector=None):
+                 train_seed: int, fault_injector=None, state_store=None):
         if spec.agg_every > num_clients:
             raise ValueError(
                 f"agg_every={spec.agg_every} > num_clients={num_clients}: "
@@ -145,6 +145,16 @@ class AsyncEngine:
         self.num_clients = int(num_clients)
         self.process = spec.process()
         self.faults = fault_injector
+        # Out-of-core composition (blades_tpu/state): the registered
+        # population's opt rows live behind a host/disk store — keyed,
+        # like the version vector below, by REGISTERED id — and each
+        # cycle gathers/scatters only the event cohort's rows (the
+        # cycle program then carries (K, ...) cohort-windowed buffers
+        # instead of the full (n, ...) stack).
+        self.state_store = state_store
+        from blades_tpu.state.store import StoreStats
+
+        self.store_stats = StoreStats()
         corrupt_mode = (fault_injector.corrupt_mode
                         if fault_injector is not None
                         and fault_injector.corrupt_rate > 0.0 else None)
@@ -154,6 +164,7 @@ class AsyncEngine:
             weight_power=spec.weight_power,
             weight_cutoff=spec.weight_cutoff,
             corrupt_mode=corrupt_mode,
+            windowed_state=state_store is not None,
         ))
         # Per-event training keys fold (seed, tick, client) off this base
         # — the async analogue of the sync driver's split chain, with no
@@ -283,12 +294,43 @@ class AsyncEngine:
 
         data_x, data_y, lengths = train_arrays
         k_agg = cycle_agg_key(self._key_base, self.version)
-        state, metrics = self._cycle(
-            state, data_x, data_y, lengths,
-            jnp.asarray(clients), jnp.asarray(ticks),
-            jnp.asarray(staleness), jnp.asarray(mal_host),
-            jnp.asarray(corrupt), self._key_base, k_agg,
-        )
+        if self.state_store is not None:
+            # Out-of-core event cohort: gather the K arriving clients'
+            # opt rows + data shards host-side (the engine IS the
+            # sanctioned host boundary), run the cohort-windowed cycle,
+            # scatter the updated rows back.
+            from blades_tpu.obs.trace import now
+            from dataclasses import replace as _dc_replace
+
+            t0 = now()
+            rows = self.state_store.gather(clients)
+            ex = jnp.asarray(np.asarray(data_x)[clients])
+            ey = jnp.asarray(np.asarray(data_y)[clients])
+            eln = jnp.asarray(np.asarray(lengths)[clients])
+            staged = (len(clients) * self.state_store.row_bytes
+                      + ex.nbytes + ey.nbytes + eln.nbytes)
+            self.store_stats.observe(
+                now() - t0, staged,
+                self.state_store.device_bytes()
+                + 2 * len(clients) * self.state_store.row_bytes
+                + ex.nbytes + ey.nbytes + eln.nbytes)
+            state = _dc_replace(state, client_opt=rows["client_opt"])
+            state, metrics = self._cycle(
+                state, ex, ey, eln,
+                jnp.asarray(clients), jnp.asarray(ticks),
+                jnp.asarray(staleness), jnp.asarray(mal_host),
+                jnp.asarray(corrupt), self._key_base, k_agg,
+            )
+            self.state_store.scatter(clients,
+                                     {"client_opt": state.client_opt})
+            state = _dc_replace(state, client_opt=None)
+        else:
+            state, metrics = self._cycle(
+                state, data_x, data_y, lengths,
+                jnp.asarray(clients), jnp.asarray(ticks),
+                jnp.asarray(staleness), jnp.asarray(mal_host),
+                jnp.asarray(corrupt), self._key_base, k_agg,
+            )
         self.version += 1
 
         hist = np.bincount(

@@ -207,6 +207,18 @@ class CodecConfig:
             return None
         return jnp.zeros((num_clients, num_params), jnp.float32)
 
+    def init_residual_row(self, num_params: int) -> jax.Array:
+        """ONE client's error-feedback residual row — the
+        participation-window store's template
+        (:func:`blades_tpu.state.store.client_state_template`): under
+        ``state_store="host"|"disk"`` the ``(n, d)`` residual never
+        exists; only the sampled cohort's rows are gathered into
+        ``RoundState.residual`` each round and scattered back after,
+        windowed exactly like the optimizer state.  Callers must gate
+        on :attr:`needs_residual` (raising here would make the
+        template builder's unconditional probe awkward)."""
+        return jnp.zeros((num_params,), jnp.float32)
+
     # -- the transform -------------------------------------------------------
 
     def encode_decode(

@@ -59,6 +59,20 @@ class RoundState:
     arriving client's update is computed against the version it actually
     pulled.  Same ``None``-when-off discipline — only
     ``execution="async"`` adds the leaf.
+
+    ``cohort`` is the participation-window subsystem's ``(window,)``
+    int32 vector of REGISTERED client ids (see
+    :mod:`blades_tpu.state`): under a windowed state store,
+    ``client_opt`` (and ``residual``) stack only the sampled cohort's
+    rows and ``cohort`` records which registered clients they belong
+    to; the registered-population remainder lives behind the driver's
+    :class:`~blades_tpu.state.store.ClientStateStore` handle — a HOST
+    object (it owns numpy arrays / memmaps and a worker thread), so
+    the handle itself stays on :class:`~blades_tpu.algorithms.fedavg.
+    Fedavg` with the same ``None``-when-off discipline and never
+    enters this pytree.  ``cohort=None`` (every pre-window build, and
+    every run without a windowed store) keeps the pytree — and
+    therefore checkpoints and sharding specs — unchanged.
     """
 
     server: ServerState
@@ -66,15 +80,18 @@ class RoundState:
     stale: Any = None
     residual: Any = None
     arrivals: Any = None
+    cohort: Any = None
 
 
 jax.tree_util.register_pytree_node(
     RoundState,
-    # getattr: checkpoints pickled before the chaos/comm/arrivals layers
-    # existed restore as RoundState instances without the late fields.
+    # getattr: checkpoints pickled before the chaos/comm/arrivals/state
+    # layers existed restore as RoundState instances without the late
+    # fields.
     lambda s: ((s.server, s.client_opt, getattr(s, "stale", None),
                 getattr(s, "residual", None),
-                getattr(s, "arrivals", None)), None),
+                getattr(s, "arrivals", None),
+                getattr(s, "cohort", None)), None),
     lambda _, c: RoundState(*c),
 )
 
@@ -164,6 +181,12 @@ class FedRound:
     # d_chunk knob applied to the dense wire path; kernel-eligible
     # shapes take the fused pallas stripe kernel instead).
     agg_d_chunk: int = 1 << 17
+    # Stateless clients (blades_tpu/state, the window=0 degenerate
+    # case): every round re-initializes the per-client optimizer state
+    # instead of carrying it — no per-client information persists
+    # across rounds, so the participation-window store has nothing to
+    # hold.  False keeps the round program literally unchanged.
+    stateless_clients: bool = False
 
     # -- construction -------------------------------------------------------
 
@@ -198,6 +221,27 @@ class FedRound:
             stale=stale,
             residual=residual,
         )
+
+    def init_windowed(self, key: jax.Array, window: int):
+        """:meth:`init` for a participation-window run
+        (:mod:`blades_tpu.state`): the per-client stacks are NOT
+        materialised — at the registered populations the window store
+        exists for (1M clients), a dense ``(n, d)`` broadcast would
+        OOM before the store could ever help.  Returns ``(state,
+        template)`` where ``state`` carries the server only
+        (``client_opt=None`` until the first cohort is staged) and
+        ``template`` is ONE client's persistent-state row
+        (:func:`blades_tpu.state.store.client_state_template`) the
+        store broadcasts host/disk-side.  The server's aggregator
+        state is sized to ``window`` — the matrix it will actually
+        aggregate every round."""
+        from blades_tpu.state.store import client_state_template
+
+        params = self.task.init_params(key)
+        template = client_state_template(self, params)
+        return RoundState(
+            server=self.server.init(params, window), client_opt=None,
+        ), template
 
     # -- hooks --------------------------------------------------------------
 
@@ -294,6 +338,17 @@ class FedRound:
         del k_sample  # consumed by sample_round_batches
         hooks = self._hooks()
         client_keys = jax.random.split(k_train, num_clients)
+        if self.stateless_clients:
+            # window=0 degenerate case (blades_tpu/state): clients keep
+            # no state across rounds — every lane starts from a fresh
+            # optimizer init (a trace-time constant broadcast, fused by
+            # XLA), and the carried stack is ignored.
+            opt0 = self.task.init_client_opt_state(state.server.params)
+            state = dataclasses.replace(
+                state,
+                client_opt=jax.tree.map(
+                    lambda x: jnp.broadcast_to(
+                        x, (num_clients,) + jnp.shape(x)), opt0))
 
         # Phase named_scopes (blades/<phase>): HLO op-name metadata for
         # the profiler/span correlation — trace-time only, numerics
@@ -476,7 +531,8 @@ class FedRound:
             metrics["lane_healthy"] = healthy_mask.astype(jnp.float32)
         return RoundState(server=server, client_opt=client_opt, stale=stale,
                           residual=residual,
-                          arrivals=getattr(state, "arrivals", None)), metrics
+                          arrivals=getattr(state, "arrivals", None),
+                          cohort=getattr(state, "cohort", None)), metrics
 
     def _finish_wire(
         self,
@@ -556,6 +612,7 @@ class FedRound:
             server=server, client_opt=client_opt,
             stale=getattr(state, "stale", None), residual=residual,
             arrivals=getattr(state, "arrivals", None),
+            cohort=getattr(state, "cohort", None),
         ), metrics
 
     def multi_step(

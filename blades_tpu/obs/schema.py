@@ -86,6 +86,24 @@ ROUND_RECORD_FIELDS: Dict[str, Tuple[tuple, bool]] = {
     "arrivals_dropped": ((int,), False),
     "updates_per_sec": (_NUM, False),
     "arrival_seed": ((int,), False),
+    # Out-of-core per-client state (blades_tpu/state): participation-
+    # window staging telemetry, stamped host-side by the driver on
+    # windowed (and async out-of-core) rounds.  state_store names the
+    # backend holding the off-cohort rows ("resident"|"host"|"disk"),
+    # cohort_size the per-round participation window (the async event
+    # batch under execution="async"), state_stage_ms the wall time the
+    # staging job spent gathering the cohort (measured via the span
+    # layer's sanctioned clock — like updates_per_sec, the one
+    # non-replayable slice), state_bytes_staged the host->device bytes
+    # it moved, and state_peak_hbm_bytes the analytic ceiling on
+    # device-resident per-client state (store-held bytes + the staged/
+    # live/write-back cohort slots) — window-proportional by
+    # construction, never O(n_registered * d).
+    "state_store": ((str,), False),
+    "cohort_size": ((int,), False),
+    "state_stage_ms": (_NUM, False),
+    "state_bytes_staged": ((int,), False),
+    "state_peak_hbm_bytes": ((int,), False),
     # comm subsystem (blades_tpu/comm): per-round uplink byte accounting
     # for compressed-update codecs.  comm_bytes_up is the client->server
     # wire payload (reconciled against parallel/comm_model.uplink_bytes),

@@ -95,12 +95,26 @@ class Plan:
     rounds_per_dispatch: int = 1      # chained scan window; 1 = per-round
     prefetch: bool = False            # dense single-round batch staging
     agg_domain: str = "f32"           # "f32" | "wire" (dense + quant codec)
+    # Participation-window store (blades_tpu/state): where off-cohort
+    # per-client rows live and the pinned cohort size (None = no
+    # window — the pre-store program).  Backends are bit-identical by
+    # contract; the knob still rides the reassociating tier because it
+    # reshapes the staging pipeline rather than the numerics tiering
+    # the default tier was defined over.
+    state_store: str = "resident"     # "resident" | "host" | "disk"
+    state_window: Optional[int] = None
     tier: str = DEFAULT_TIER          # numerics tier this plan belongs to
 
     def __post_init__(self):
         if self.execution not in ("dense", "streamed"):
             raise ValueError(f"plan execution must be dense|streamed, "
                              f"got {self.execution!r}")
+        if self.state_store not in ("resident", "host", "disk"):
+            raise ValueError(f"plan state_store must be resident|host|"
+                             f"disk, got {self.state_store!r}")
+        if self.state_window is not None and int(self.state_window) < 0:
+            raise ValueError(f"plan state_window must be None or >= 0, "
+                             f"got {self.state_window}")
         if self.agg_domain not in ("f32", "wire"):
             raise ValueError(f"plan agg_domain must be f32|wire, "
                              f"got {self.agg_domain!r}")
@@ -129,7 +143,12 @@ class Plan:
                 f"|mxu={self.mxu_finish or 'off'}"
                 f"|w{int(self.rounds_per_dispatch)}"
                 f"|{'pre' if self.prefetch else 'nopre'}"
-                + ("|wire" if self.agg_domain == "wire" else ""))
+                + ("|wire" if self.agg_domain == "wire" else "")
+                # Window-store marker only when engaged: every
+                # store-free id stays byte-identical to the pre-knob
+                # format (the agg_domain discipline).
+                + (f"|ss={self.state_store}w{int(self.state_window)}"
+                   if self.state_window is not None else ""))
 
     def as_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -158,6 +177,11 @@ def apply_plan(config, plan: Plan) -> None:
     """
     config.execution = plan.execution
     config.d_chunk = int(plan.d_chunk)
+    if plan.state_window is not None:
+        # Window pinned by construction (the plan space never varies
+        # it); the backend may have been probed, so materialise it.
+        config.state_store = plan.state_store
+        config.state_window = int(plan.state_window)
     if plan.execution == "dense":
         config.client_packing = (int(plan.client_packing)
                                  if plan.client_packing >= 2 else "off")
@@ -215,6 +239,8 @@ def enumerate_plans(
     scan_windows: Sequence[int] = (1,),
     prefetch_options: Sequence[bool] = (False,),
     agg_domains: Sequence[str] = ("f32",),
+    state_stores: Sequence[str] = ("resident",),
+    state_windows: Sequence[Optional[int]] = (None,),
     allow_reassociating: bool = False,
     max_candidates: int = MAX_CANDIDATES,
 ) -> PlanSpace:
@@ -258,22 +284,39 @@ def enumerate_plans(
             else:
                 for p in pack_factors:
                     for ad in agg_domains:
-                        tier = exe_tier
-                        if p != pack_factors[0]:
-                            tier = REASSOCIATING_TIER
-                        if ad != agg_domains[0]:
-                            # Quantized-domain statistics reassociate f32
-                            # reductions AND rank on the int8 grid — never
-                            # a default-tier handout.
-                            tier = REASSOCIATING_TIER
-                        pres = prefetch_options if int(w) == 1 else (False,)
-                        for pre in pres:
-                            plans.append(Plan(
-                                execution="dense", d_chunk=int(d_chunks[0]),
-                                client_packing=int(p), mxu_finish="",
-                                rounds_per_dispatch=int(w),
-                                prefetch=bool(pre), agg_domain=str(ad),
-                                tier=tier))
+                        for ss in state_stores:
+                            for sw in state_windows:
+                                tier = exe_tier
+                                if p != pack_factors[0]:
+                                    tier = REASSOCIATING_TIER
+                                if ad != agg_domains[0]:
+                                    # Quantized-domain statistics
+                                    # reassociate f32 reductions AND rank
+                                    # on the int8 grid — never a
+                                    # default-tier handout.
+                                    tier = REASSOCIATING_TIER
+                                if (ss != state_stores[0]
+                                        or sw != state_windows[0]):
+                                    # Store backends are bit-identical,
+                                    # but reshaping the staging pipeline
+                                    # is an opt-in probe (ISSUE 15), not
+                                    # a default-tier handout.
+                                    tier = REASSOCIATING_TIER
+                                pres = (prefetch_options if int(w) == 1
+                                        else (False,))
+                                for pre in pres:
+                                    plans.append(Plan(
+                                        execution="dense",
+                                        d_chunk=int(d_chunks[0]),
+                                        client_packing=int(p),
+                                        mxu_finish="",
+                                        rounds_per_dispatch=int(w),
+                                        prefetch=bool(pre),
+                                        agg_domain=str(ad),
+                                        state_store=str(ss),
+                                        state_window=(None if sw is None
+                                                      else int(sw)),
+                                        tier=tier))
     if not allow_reassociating:
         plans = [p for p in plans if p.tier == DEFAULT_TIER]
     # Dedupe preserving order (e.g. a chunk ladder whose entries clamp

@@ -1,0 +1,41 @@
+"""Out-of-core per-client state (the participation-window store).
+
+See :mod:`blades_tpu.state.store` for the store protocol/backends and
+:mod:`blades_tpu.state.prefetch` for the double-buffered staging
+pipeline.  Configure via ``FedavgConfig.resources(state_store=...,
+window=...)``; the README "Out-of-core client state" section documents
+the semantics and interaction matrix.
+"""
+
+from blades_tpu.state.prefetch import StagedCohort, StatePrefetcher
+from blades_tpu.state.store import (
+    COHORT_KEY_FOLD,
+    STORE_BACKENDS,
+    ClientStateStore,
+    DiskStore,
+    HostStore,
+    ResidentStore,
+    StateStoreError,
+    client_state_template,
+    cohort_key,
+    make_store,
+    read_checkpoint_rows,
+    sample_cohort,
+)
+
+__all__ = [
+    "COHORT_KEY_FOLD",
+    "STORE_BACKENDS",
+    "ClientStateStore",
+    "DiskStore",
+    "HostStore",
+    "ResidentStore",
+    "StagedCohort",
+    "StatePrefetcher",
+    "StateStoreError",
+    "client_state_template",
+    "cohort_key",
+    "make_store",
+    "read_checkpoint_rows",
+    "sample_cohort",
+]

@@ -1,0 +1,205 @@
+"""Double-buffered host<->device staging for the participation window.
+
+The :mod:`blades_tpu.data.prefetch` discipline generalized from batches
+to client STATE: while round ``r`` computes, a single worker thread
+stages round ``r+1``'s cohort — its state rows from the
+:class:`~blades_tpu.state.store.ClientStateStore`, its data shards and
+its malicious-mask rows — and writes round ``r``'s updated rows back.
+The cohort-sampling fold of the round key is consumed one round ahead
+by the driver's split chain (the same peek ``BatchPrefetcher`` uses),
+so the schedule is known before the round finishes.
+
+**Write-read hazard.**  Consecutive cohorts overlap; a row gathered
+for round ``r+1`` before round ``r``'s write-back lands would be
+stale.  The stage job therefore gathers only the ids NOT in the
+previous cohort; the overlapping rows are patched in at
+:meth:`StatePrefetcher.take` time directly from round ``r``'s output
+stack (device-to-device — those rows are already in HBM and bit-equal
+to what the write-back stores).  Jobs run FIFO on one worker, so a
+stage for round ``r+2`` (which may revisit round ``r``'s ids) always
+runs after round ``r``'s write-back.  Prefetch ON/OFF changes WHEN
+rows move, never their values — backend equivalence is
+regression-tested with staging forced on.
+
+Like the store module this file is on the blades-lint ``host-sync``
+DEVICE_SIDE list: the worker's write-back fetch (inside
+``store.scatter``) is the sanctioned sync point; nothing here may
+block the driver thread on the device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from blades_tpu.obs.trace import now
+from blades_tpu.state.store import ClientStateStore, StoreStats
+
+
+@dataclasses.dataclass
+class StagedCohort:
+    """One staged participation window, ready for assembly."""
+
+    index: int
+    ids: np.ndarray                 # (w,) ascending registered ids
+    new_pos: np.ndarray             # cohort positions gathered from the store
+    new_rows: Any                   # device pytree, (len(new_pos), ...)
+    old_pos: np.ndarray             # cohort positions patched from prev round
+    prev_pos: np.ndarray            # matching positions in the prev cohort
+    data: Tuple[jax.Array, ...]     # (x, y, lengths) cohort shards
+    malicious: jax.Array            # (w,) bool
+    bytes_staged: int
+    stage_seconds: float
+
+
+class StatePrefetcher:
+    """Stage cohort state/data for round ``r+1`` while round ``r``
+    computes, and write round ``r``'s rows back, on one FIFO worker.
+
+    ``async_staging=False`` (the CPU default — a single-threaded
+    backend has no overlap to win) runs every job inline on the caller
+    thread; the values are identical either way.
+    """
+
+    def __init__(self, store: ClientStateStore,
+                 data: Tuple[np.ndarray, ...], malicious: np.ndarray,
+                 cohort_fn: Callable[[jax.Array], np.ndarray], *,
+                 async_staging: bool = False):
+        self._store = store
+        # Host-resident inputs by contract (the driver hands numpy):
+        # stored as-is, no conversion that could mask a device leak.
+        self._data = tuple(data)
+        self._malicious = malicious
+        self._cohort = cohort_fn
+        self._pool = (ThreadPoolExecutor(max_workers=1,
+                                         thread_name_prefix="blades-state")
+                      if async_staging else None)
+        self._staged: Optional[Tuple[int, Any]] = None
+        self._pending: list = []  # write-back futures awaiting reaping
+        self.stats = StoreStats()
+
+    # -- jobs ----------------------------------------------------------------
+
+    def _submit(self, fn, *args):
+        if self._pool is None:
+            f: Future = Future()
+            f.set_result(fn(*args))
+            return f
+        return self._pool.submit(fn, *args)
+
+    def _stage_job(self, index: int, key: jax.Array,
+                   prev_ids: Optional[np.ndarray]) -> StagedCohort:
+        t0 = now()
+        ids = self._cohort(key)
+        if prev_ids is None:
+            new_mask = np.ones(len(ids), bool)
+        else:
+            new_mask = ~np.isin(ids, prev_ids)
+        new_pos = np.nonzero(new_mask)[0]
+        old_pos = np.nonzero(~new_mask)[0]
+        prev_pos = (np.searchsorted(prev_ids, ids[old_pos])
+                    if prev_ids is not None else np.zeros(0, np.int64))
+        new_rows = self._store.gather(ids[new_pos])
+        x, y, ln = self._data
+        data = (jnp.asarray(x[ids]), jnp.asarray(y[ids]),
+                jnp.asarray(ln[ids]))
+        mal = jnp.asarray(self._malicious[ids])
+        staged_bytes = (len(new_pos) * self._store.row_bytes
+                        + sum(d.size * np.dtype(d.dtype).itemsize
+                              for d in data))
+        return StagedCohort(
+            index=index, ids=ids, new_pos=new_pos, new_rows=new_rows,
+            old_pos=old_pos, prev_pos=prev_pos, data=data, malicious=mal,
+            bytes_staged=int(staged_bytes), stage_seconds=now() - t0,
+        )
+
+    # -- driver API ----------------------------------------------------------
+
+    def stage(self, index: int, key: jax.Array,
+              prev_ids: Optional[np.ndarray]) -> None:
+        """Dispatch the staging job for round ``index`` under ``key``
+        (the driver's peeked next-round key).  ``prev_ids`` is the
+        in-flight round's cohort — its rows are excluded from the
+        store gather (the hazard rule above)."""
+        self._staged = (index, self._submit(self._stage_job, index, key,
+                                            prev_ids))
+
+    def take(self, index: int, key: jax.Array,
+             prev: Optional[Tuple[np.ndarray, Dict[str, Any]]]):
+        """The assembled cohort for round ``index``: the staged entry
+        when the pipeline is warm (index must match), else a
+        synchronous gather.  ``prev`` is ``(prev_ids, prev_rows)`` from
+        the previous round's output — overlap rows come from there.
+        Returns ``(ids, state_rows, (x, y, ln), malicious)``."""
+        staged, self._staged = self._staged, None
+        sc: Optional[StagedCohort] = None
+        if staged is not None and staged[0] == index:
+            sc = staged[1].result()
+        if sc is None:
+            sc = self._stage_job(index, key,
+                                 prev[0] if prev is not None else None)
+        prev_rows = prev[1] if prev is not None else None
+
+        def assemble(shape_dtype_new, prev_leaf):
+            buf = jnp.zeros((len(sc.ids),) + shape_dtype_new.shape[1:],
+                            shape_dtype_new.dtype)
+            buf = buf.at[jnp.asarray(sc.new_pos)].set(shape_dtype_new)
+            if len(sc.old_pos):
+                patch = prev_leaf[jnp.asarray(sc.prev_pos)]
+                buf = buf.at[jnp.asarray(sc.old_pos)].set(patch)
+            return buf
+
+        # new_pos/old_pos partition the cohort, so no-overlap means the
+        # gather covered every position.
+        if len(sc.old_pos):
+            state = jax.tree.map(assemble, sc.new_rows, prev_rows)
+        else:
+            state = sc.new_rows  # fully fresh: the gather IS the cohort
+        hbm = (self._store.device_bytes()
+               + 3 * len(sc.ids) * self._store.row_bytes
+               + sum(d.size * np.dtype(d.dtype).itemsize
+                     for d in sc.data))
+        self.stats.observe(sc.stage_seconds, sc.bytes_staged, hbm)
+        return sc.ids, state, sc.data, sc.malicious
+
+    def _reap(self, wait: bool = False) -> None:
+        """Surface write-back failures: a scatter that raised on the
+        worker (disk full, memmap IO error) must fail the trial, not
+        silently serve stale rows at the next gather/checkpoint."""
+        still_pending = []
+        for f in self._pending:
+            if wait or f.done():
+                f.result()  # re-raises the worker's exception
+            else:
+                still_pending.append(f)
+        self._pending = still_pending
+
+    def writeback(self, ids: np.ndarray, rows: Any) -> None:
+        """Enqueue the round's updated cohort rows for the store.  The
+        worker's fetch blocks until the round's compute lands — that
+        wait belongs on the worker, never the driver thread."""
+        self._reap()
+        self._pending.append(self._submit(self._store.scatter, ids, rows))
+
+    def flush(self) -> None:
+        """Drain the worker queue: every pending write-back has reached
+        the store — and any write-back failure has been re-raised —
+        before a checkpoint streams shards."""
+        self._reap(wait=True)
+        self._submit(lambda: None).result()
+
+    def invalidate(self) -> None:
+        """Drop staged work after the driver's key chain rewinds
+        (checkpoint restore) — a stale cohort must never feed a
+        restored round."""
+        self.flush()
+        self._staged = None
+
+    def close(self) -> None:
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)

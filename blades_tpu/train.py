@@ -166,6 +166,18 @@ def main(argv=None) -> int:
                        "\"agg_every\": 16, \"weight_schedule\": "
                        "\"polynomial\"}' (AsyncSpec knobs; seed defaults "
                        "to the trial seed)")
+    p_run.add_argument("--state-store", default=None,
+                       choices=("resident", "host", "disk"),
+                       help="out-of-core per-client state backend "
+                       "(blades_tpu/state): where off-cohort optimizer/"
+                       "EF-residual rows live; 'host'/'disk' require "
+                       "--window (see README \"Out-of-core client "
+                       "state\")")
+    p_run.add_argument("--window", type=int, default=None, metavar="W",
+                       help="participation window: clients sampled into "
+                       "each round's cohort (0 = stateless clients, the "
+                       "degenerate case); only the cohort's state rows "
+                       "are device-resident under a host/disk store")
 
     args = parser.parse_args(argv)
     scan_window = (args.scan_window if args.scan_window == "auto"
@@ -217,6 +229,10 @@ def main(argv=None) -> int:
             run_config["execution"] = args.execution
         if args.arrivals_json is not None:
             run_config["async_config"] = json.loads(args.arrivals_json)
+        if args.state_store is not None:
+            run_config["state_store"] = args.state_store
+        if args.window is not None:
+            run_config["state_window"] = args.window
         experiments = {
             f"{args.algo.lower()}_run": {
                 "run": args.algo,

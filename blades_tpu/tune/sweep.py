@@ -175,6 +175,12 @@ def _lanes_eligible(spec_run: str, trial: Dict, group: List[int]) -> bool:
         # Same for the chaos layer: the laned program has no fault
         # injection, so a faulted trial would silently run failure-free.
         return False
+    if getattr(cfg, "state_window", None) is not None:
+        # Participation-window / stateless trials run sequentially: the
+        # vmapped lane program has no cohort staging (and no stateless
+        # re-init), so a laned trial would silently train the resident
+        # full-participation round instead.
+        return False
     if getattr(cfg, "autotune_mode", None):
         # The vmapped lane program has no plan machinery — an autotuned
         # trial runs sequentially so its plan resolution, provenance
@@ -248,6 +254,12 @@ def _eligible_scan_windows(config, max_rounds: int, checkpoint_freq: int,
     if int(getattr(config, "rounds_per_dispatch", 1) or 1) != 1:
         return (1,)
     if getattr(config, "forensics", False):
+        return (1,)
+    if getattr(config, "state_window", None) is not None \
+            and config.state_window >= 1:
+        # Participation-window trials stay sequential: cohort staging
+        # (store gather/scatter) happens BETWEEN dispatches — a scanned
+        # window would need an in-program store round trip.
         return (1,)
     if getattr(config, "num_devices", None):
         return (1,)
@@ -1319,6 +1331,17 @@ def run_experiments(
                 cost = algo.cost_analysis()
                 if cost:
                     summary["cost"] = cost
+            state_block = getattr(algo, "state_summary", None)
+            if state_block:
+                # Out-of-core client state (blades_tpu/state): store
+                # backend + window + the staging peak, mirrored from the
+                # row stamps like the comm/arrivals blocks.
+                summary["state_store"] = state_block
+            if hasattr(algo, "stop"):
+                # Release trial-scoped resources (the window store's
+                # temp/memmap directories, the staging worker); the
+                # Trainable surface documents stop() as idempotent.
+                algo.stop()
             if failed_error is not None:
                 summary["status"] = "ERROR"
                 summary["error"] = failed_error

@@ -1,0 +1,25 @@
+"""Seeded staging-discipline violations (blades-lint fixture, never
+imported): blocking device syncs inside the participation-window
+staging hot path, OUTSIDE the sanctioned prefetcher boundary.  Scanned
+only when the test instantiates HostSyncPass with this path in its
+module list (the real pass scans blades_tpu/state/ via DEVICE_SIDE).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def leaky_stage(store, ids, prev_rows):
+    rows = store.gather(ids)
+    checksum = float(jnp.abs(rows).sum())  # BAD: blocks staging on the device
+    host_rows = np.asarray(rows)  # BAD: numpy conversion mid-stage
+    return rows, checksum, host_rows
+
+
+def leaky_writeback_probe(new_state):
+    # BAD: fetching per-row norms on the DRIVER thread stalls the
+    # dispatch pipeline — the write-back fetch belongs on the worker.
+    norms = jax.device_get(jnp.linalg.norm(new_state, axis=1))
+    count = new_state.sum().item()  # BAD: .item()
+    new_state.block_until_ready()  # BAD: queue drain in the hot path
+    return norms, count

@@ -111,6 +111,28 @@ def test_host_sync_fixtures():
     assert run_fixture([hs_good], "hostsync_good.py") == []
 
 
+def test_staging_discipline_fixtures():
+    """ISSUE 15: the host-sync pass covers the out-of-core staging hot
+    path (blades_tpu/state/ rides DEVICE_SIDE) — a blocking fetch
+    anywhere but the pragma'd prefetcher boundary is a finding."""
+    from tools.lint.passes.host_sync import DEVICE_SIDE
+
+    assert "blades_tpu/state/store.py" in DEVICE_SIDE
+    assert "blades_tpu/state/prefetch.py" in DEVICE_SIDE
+    hs = HostSyncPass(modules=[f"{FIX}/stagingdiscipline_bad.py"])
+    bad = errors_of(run_fixture([hs], "stagingdiscipline_bad.py"),
+                    "host-sync")
+    msgs = "\n".join(f.message for f in bad)
+    assert "float() on an array expression" in msgs
+    assert "np.asarray()" in msgs
+    assert "jax.device_get()" in msgs
+    assert ".item()" in msgs
+    assert ".block_until_ready()" in msgs
+    assert len(bad) == 5
+    hs_good = HostSyncPass(modules=[f"{FIX}/stagingdiscipline_good.py"])
+    assert run_fixture([hs_good], "stagingdiscipline_good.py") == []
+
+
 def test_static_args_fixtures():
     sa = StaticArgsPass(prefixes=[f"{FIX}/static_bad.py"])
     bad = errors_of(run_fixture([sa], "static_bad.py"), "static-config")

@@ -41,6 +41,14 @@ DEVICE_SIDE = (
     "blades_tpu/arrivals/cycle.py",
     "blades_tpu/arrivals/process.py",
     "blades_tpu/arrivals/weights.py",
+    # Out-of-core state staging (ISSUE 15): the store + prefetcher ARE
+    # the staging hot path — a stray blocking fetch there stalls the
+    # round pipeline exactly like one inside the jitted round.  The
+    # sanctioned prefetcher boundary (cohort-id fetch, the write-back
+    # fetch, one-time store init) carries per-line justification
+    # pragmas; everything else is a finding.
+    "blades_tpu/state/store.py",
+    "blades_tpu/state/prefetch.py",
     "blades_tpu/ops/aggregators.py",
     "blades_tpu/ops/clustering.py",
     "blades_tpu/ops/layout.py",
