@@ -30,7 +30,10 @@ Two measured workloads, one JSON line:
    participation window — resident vs host out-of-core client-state
    staging (``blades_tpu/state``) plus a large-n host-only point —
    reporting staging telemetry next to the wall times, on both
-   backends.)
+   backends.  And env-gated ``BLADES_BENCH_LEDGER``: the same protocol
+   with the client-lifetime ledger (``blades_tpu/obs/ledger.py``)
+   folding the full cohort every round vs bare, held to the PR 12 <2%
+   overhead bar, on both backends.)
 2. **ResNet-18 @ 768 clients** (the model BASELINE.json actually names):
    768 is the single-chip capacity limit under malicious-lane elision —
    the benign-compacted bf16 update matrix stores 576 rows = 12.9 GB
@@ -767,6 +770,113 @@ def _trace_block(cpu: bool) -> dict:
     }
 
 
+def _measure_ledger_cnn(armed: bool, *, num_clients=32, timed_rounds=4,
+                        model="cnn", input_shape=(32, 32, 3)) -> dict:
+    """One arm of the BLADES_BENCH_LEDGER A/B: the 32-client dense CNN
+    protocol with the driver-style per-round fetch, either bare or with
+    the client ledger armed — observe() folding the full cohort every
+    round (participation, flag churn, score EWMA, norm Welford) plus
+    the round_fields() fleet stamp.  BOTH arms pay the identical device
+    work and row fetch; the diagnosis columns the armed arm feeds the
+    ledger are host-synthesized (deterministic rng), so the delta is
+    the ledger's pure host cost — its zero-extra-device-syncs contract
+    measured, not asserted."""
+    from blades_tpu.adversaries import get_adversary, make_malicious_mask
+    from blades_tpu.core import FedRound, Server, TaskSpec
+    from blades_tpu.obs.ledger import make_ledger
+
+    num_byzantine = num_clients // 4
+    task = TaskSpec(model=model, input_shape=input_shape, num_classes=10,
+                    lr=0.1).build()
+    server = Server.from_config(aggregator="Median", lr=0.5)
+    adv = get_adversary("ALIE", num_clients=num_clients,
+                        num_byzantine=num_byzantine)
+    fr = FedRound(task=task, server=server, adversary=adv,
+                  batch_size=min(BATCH, 8),
+                  num_batches_per_round=LOCAL_STEPS)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(num_clients, 8, *input_shape)),
+                    jnp.float32)
+    y = jnp.asarray(rng.integers(0, 10, size=(num_clients, 8)), jnp.int32)
+    lengths = jnp.full((num_clients,), 8, jnp.int32)
+    mal = make_malicious_mask(num_clients, num_byzantine)
+    state = fr.init(jax.random.PRNGKey(0), num_clients)
+    step = jax.jit(fr.step, donate_argnums=(0,))
+
+    ledger = make_ledger("resident", num_clients) if armed else None
+    ids = np.arange(num_clients, dtype=np.int64)
+    diag_rng = np.random.default_rng(7)
+
+    def one_round(r, key):
+        nonlocal state
+        state, m = step(state, x, y, lengths, mal, key)
+        # Driver-style per-round fetch: BOTH arms pay this sync.
+        row = {
+            "training_iteration": r + 1,
+            "train_loss": float(m["train_loss"]),
+            "agg_norm": float(m["agg_norm"]),
+            "update_norm_mean": float(m["update_norm_mean"]),
+        }
+        if armed:
+            scores = diag_rng.normal(size=num_clients)
+            ledger.observe(ids, round=r + 1, flagged=scores > 1.0,
+                           scores=scores,
+                           norms=np.abs(diag_rng.normal(size=num_clients)))
+            row.update(ledger.round_fields())
+        return row
+
+    row = one_round(-1, jax.random.PRNGKey(1))  # warmup / compile
+    t0 = time.perf_counter()
+    for r in range(timed_rounds):
+        key = jax.random.fold_in(jax.random.PRNGKey(2), r)
+        row = one_round(r, key)
+    dt = time.perf_counter() - t0
+    assert row["train_loss"] == row["train_loss"]  # NaN guard
+    out = {
+        "rounds_per_sec": round(timed_rounds / dt, 4),
+        "round_s": round(dt / timed_rounds, 4),
+        "clients": num_clients, "byzantine": num_byzantine,
+        "model": model, "timed_rounds": timed_rounds,
+        "aggregator": "Median", "adversary": "ALIE",
+        "armed": armed,
+    }
+    if armed:
+        out["ledger_clients_seen"] = row["ledger_clients_seen"]
+        out["suspected_fraction"] = row["suspected_fraction"]
+    return out
+
+
+def _ledger_block(cpu: bool) -> dict:
+    """BLADES_BENCH_LEDGER satellite (ISSUE 16): round wall-time with
+    the client-lifetime ledger armed (full-cohort observe + fleet
+    round_fields each round) vs bare, on the 32-client dense CNN
+    protocol — held to the same <2% acceptance bar as the PR 12
+    observability layer.  Rides the TPU-probe + cpu_fallback machinery
+    like the other A/Bs; on the 2-core fallback box the stamped
+    numbers, not the threshold, are the record."""
+    if cpu:
+        kw = dict(model="mlp", input_shape=(8, 8, 1), num_clients=16,
+                  timed_rounds=30)
+    else:
+        kw = dict(model="cnn", input_shape=(32, 32, 3), num_clients=32,
+                  timed_rounds=5)
+    bare = _measure_ledger_cnn(False, **kw)
+    armed = _measure_ledger_cnn(True, **kw)
+    overhead_pct = None
+    if armed["rounds_per_sec"]:
+        overhead_pct = round(
+            (bare["rounds_per_sec"] / armed["rounds_per_sec"] - 1.0)
+            * 100.0, 3)
+    return {
+        "bare": bare,
+        "armed": armed,
+        "overhead_pct": overhead_pct,
+        "acceptance": "overhead < 2% with the ledger armed",
+        "acceptance_met": (overhead_pct is not None
+                           and overhead_pct < 2.0),
+    }
+
+
 def _measure_autotuned(tuned: bool, plan_cache_dir: str, *, num_clients,
                        model, dataset, input_shape, timed_rounds) -> dict:
     """One config-driven run of the bench protocol through the FULL
@@ -1062,6 +1172,13 @@ def _cpu_fallback(probe_err: str) -> None:
             out["trace"] = _trace_block(cpu=True)
         except Exception as e:
             out["trace"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+    if os.environ.get("BLADES_BENCH_LEDGER", "1") == "1":
+        try:
+            # Client-ledger overhead A/B (ISSUE 16) on the reduced CPU
+            # config — full-cohort observe + fleet stamp armed vs bare.
+            out["ledger"] = _ledger_block(cpu=True)
+        except Exception as e:
+            out["ledger"] = {"error": f"{type(e).__name__}: {e}"[:300]}
     if os.environ.get("BLADES_BENCH_ASYNC", "1") == "1":
         try:
             # Buffered-async ingest (ISSUE 14) on the reduced CPU
@@ -1186,6 +1303,16 @@ def main() -> None:
             out["trace"] = _trace_block(cpu=False)
         except Exception as e:
             out["trace"] = {"error": f"{type(e).__name__}: {e}"[:500]}
+
+    if os.environ.get("BLADES_BENCH_LEDGER", "1") == "1":
+        try:
+            # Client-ledger overhead A/B (ISSUE 16): the 32-client dense
+            # CNN protocol with the lifetime ledger folding the full
+            # cohort every round vs bare — acceptance: overhead < 2%
+            # with the ledger armed (the PR 12 bar).
+            out["ledger"] = _ledger_block(cpu=False)
+        except Exception as e:
+            out["ledger"] = {"error": f"{type(e).__name__}: {e}"[:500]}
 
     if os.environ.get("BLADES_BENCH_ASYNC", "1") == "1":
         try:

@@ -215,8 +215,23 @@ class FedavgConfig:
         self.async_config: Optional[Dict] = None
         # defense forensics (obs subsystem): per-lane aggregator telemetry
         # + Byzantine detection precision/recall/FPR emitted from inside
-        # the jitted round; dense single-chip execution only
+        # the jitted round.  Cohort-shaped: the dense round's lanes are
+        # registered clients, the windowed round's lanes are the sampled
+        # cohort, the async cycle's lanes are buffered arrival events —
+        # each row's lane_forensics carries the cohort id-vector that
+        # maps lanes back to registered ids.  Single-chip; the
+        # streamed/d-sharded paths never materialise per-lane decisions.
         self.forensics: bool = False
+        # Client-lifetime ledger (obs/ledger.py): one longitudinal
+        # record per registered client (participation/flagged counts,
+        # detection-score EWMA, staleness/norm running stats), updated
+        # host-side per round.  False disables; True = the "resident"
+        # host-RAM backend; "resident"|"disk" select explicitly ("disk"
+        # memmaps the columns for 100k+ registered clients).
+        self.ledger: Any = False
+        # Directory for the disk ledger's live memmap columns (None = a
+        # private temp dir, removed when the trial stops).
+        self.ledger_dir: Optional[str] = None
         # server root-dataset size for trust-bootstrapped aggregators (FLTrust)
         self.fltrust_root_size: int = 100
         # resources
@@ -359,10 +374,14 @@ class FedavgConfig:
                 spec[k] = v
         return self._set(async_config=spec or None)
 
-    def observability(self, *, forensics=None):
-        """Defense forensics: per-lane aggregator diagnostics + Byzantine
-        detection precision/recall/FPR per round (obs subsystem)."""
-        return self._set(forensics=forensics)
+    def observability(self, *, forensics=None, ledger=None, ledger_dir=None):
+        """Defense forensics (per-lane aggregator diagnostics + Byzantine
+        detection precision/recall/FPR per round) and the client-lifetime
+        ledger (``ledger=True`` for the resident backend, ``"disk"`` to
+        memmap the columns; ``ledger_dir=`` the disk backend's live
+        directory) — the obs subsystem."""
+        return self._set(forensics=forensics, ledger=ledger,
+                         ledger_dir=ledger_dir)
 
     def communication(self, *, codec=None, agg_domain=None):
         """Compressed-update codec on the client->server uplink
@@ -493,26 +512,37 @@ class FedavgConfig:
                     "program has no mesh formulation — run without "
                     "num_devices or use a synchronous path"
                 )
-            for knob, why in (
-                (self.forensics, "defense forensics"),
-                (self.codec_config, "update codecs"),
-                (self.agg_domain != "f32", "wire-domain aggregation"),
+            # Defense forensics COMPOSES with async since the cohort-
+            # shaped forensics work: the cycle diagnoses the (K, d)
+            # event matrix and lanes are re-indexed by the event
+            # id-vector (Server.step_buffered_diag).  The remaining
+            # gates name the exact pair and the knob that flips it.
+            for knob, why, flip in (
+                (self.codec_config, "update codecs",
+                 ".communication(codec=None)"),
+                (self.agg_domain != "f32", "wire-domain aggregation",
+                 ".communication(agg_domain='f32')"),
                 (self.client_packing not in ("off", None),
-                 "client lane-packing"),
-                (self.autotune_mode, "the execution autotuner"),
+                 "client lane-packing",
+                 ".resources(client_packing='off')"),
+                (self.autotune_mode, "the execution autotuner",
+                 ".resources(autotune='off')"),
                 (int(self.rounds_per_dispatch or 1) != 1,
-                 "rounds_per_dispatch > 1"),
-                (self.chained_dispatch, "chained_dispatch"),
-                (self.health_check, "the in-round health check"),
-                (self.dp_clip_threshold, "client DP"),
+                 "rounds_per_dispatch > 1", "rounds_per_dispatch=1"),
+                (self.chained_dispatch, "chained_dispatch",
+                 "chained_dispatch=False"),
+                (self.health_check, "the in-round health check",
+                 ".fault_tolerance(health_check=False)"),
+                (self.dp_clip_threshold, "client DP",
+                 "dp_clip_threshold=None"),
             ):
                 if knob:
                     raise ValueError(
-                        f"execution='async' cannot compose with {why} "
-                        "yet: the buffered cycle aggregates arrival "
-                        "EVENTS, not the lockstep (n, d) round those "
-                        "stages are formulated over — drop the feature "
-                        "or use a synchronous execution path"
+                        f"execution='async' × {why} is an unsupported "
+                        "pair: the buffered cycle aggregates arrival "
+                        "EVENTS, not the lockstep (n, d) round that "
+                        f"stage is formulated over — set {flip}, or use "
+                        "a synchronous execution path"
                     )
             injector = self.get_fault_injector()
             if injector is not None and injector.num_stragglers:
@@ -542,17 +572,19 @@ class FedavgConfig:
         if self.forensics:
             if self.execution in ("streamed", "dsharded"):
                 raise ValueError(
-                    "forensics per-lane telemetry is only formulated for the "
-                    "dense round; the streamed/d-sharded paths never "
-                    "materialise the per-lane decisions it reports — use "
-                    "execution='dense' (or 'auto' within the dense budget) "
-                    "or disable forensics"
+                    f"forensics × execution={self.execution!r} is an "
+                    "unsupported pair: the streamed/d-sharded paths never "
+                    "materialise the per-lane decisions forensics reports "
+                    "— set .resources(execution='dense') (or 'auto' "
+                    "within the dense budget), or flip "
+                    ".observability(forensics=False)"
                 )
             if self.num_devices and self.num_devices > 1:
                 raise ValueError(
-                    "forensics is single-chip for now: per-lane diagnostics "
-                    "under shard_map would shard the lane axis — run the "
-                    "forensic pass without num_devices, or disable forensics"
+                    "forensics × num_devices>1 is an unsupported pair: "
+                    "per-lane diagnostics under shard_map would shard "
+                    "the lane axis — set .resources(num_devices=None), "
+                    "or flip .observability(forensics=False)"
                 )
         if self.fault_config:
             # Build the injector now so a bad spec fails at validate()
@@ -611,18 +643,23 @@ class FedavgConfig:
                     ".communication(codec={'type': 'quant', ...}) or "
                     "keep agg_domain='f32'"
                 )
-            for knob, why in (
-                (self.fault_config, "fault injection"),
-                (self.health_check, "the in-round health check"),
-                (self.forensics, "defense forensics"),
-                (self.dp_clip_threshold, "client DP"),
+            for knob, why, flip in (
+                (self.fault_config, "fault injection",
+                 ".fault_tolerance(faults=None)"),
+                (self.health_check, "the in-round health check",
+                 ".fault_tolerance(health_check=False)"),
+                (self.forensics, "defense forensics",
+                 ".observability(forensics=False)"),
+                (self.dp_clip_threshold, "client DP",
+                 "dp_clip_threshold=None"),
             ):
                 if knob:
                     raise ValueError(
-                        f"agg_domain='wire' cannot compose with {why}: "
-                        "those stages rewrite/inspect dense f32 rows the "
-                        "wire domain never materializes — run them under "
-                        "agg_domain='f32', or drop the feature"
+                        f"agg_domain='wire' × {why} is an unsupported "
+                        "pair: that stage rewrites/inspects dense f32 "
+                        "rows the wire domain never materializes — set "
+                        f"{flip}, or run under "
+                        ".communication(agg_domain='f32')"
                     )
             from blades_tpu.parallel.streamed_geometry import (
                 WIRE_AGGREGATORS,
@@ -699,25 +736,51 @@ class FedavgConfig:
                     "the participation-window store is single-chip for "
                     "now: cohort gather/scatter has no mesh formulation "
                     "— run without num_devices or drop the window")
-            for knob, why in (
-                (self.forensics, "defense forensics (per-lane vectors "
-                 "would be indexed by a round-varying cohort)"),
+            # Forensics COMPOSES with the window since the cohort-shaped
+            # forensics work: the windowed round diagnoses the
+            # (window, d) cohort matrix against the cohort-gathered
+            # malicious mask, and the driver stamps the cohort
+            # id-vector that maps lanes back to registered ids.  The
+            # remaining gates name the exact pair and the knob that
+            # flips it.
+            for knob, why, flip in (
                 (self.fault_config, "fault injection (the straggler "
                  "ring and participation mask are keyed by lane, not "
-                 "registered id)"),
+                 "registered id)", ".fault_tolerance(faults=None)"),
                 (self.client_packing not in ("off", None),
-                 "client lane-packing"),
-                (self.agg_domain != "f32", "wire-domain aggregation"),
+                 "client lane-packing",
+                 ".resources(client_packing='off')"),
+                (self.agg_domain != "f32", "wire-domain aggregation",
+                 ".communication(agg_domain='f32')"),
                 (int(self.rounds_per_dispatch or 1) != 1,
                  "rounds_per_dispatch > 1 (cohort staging happens "
-                 "between dispatches)"),
-                (self.chained_dispatch, "chained_dispatch"),
+                 "between dispatches)", "rounds_per_dispatch=1"),
+                (self.chained_dispatch, "chained_dispatch",
+                 "chained_dispatch=False"),
             ):
                 if knob:
                     raise ValueError(
-                        f"state_window={w} cannot compose with {why} "
-                        "yet — drop the feature or run without the "
+                        f"state_window={w} × {why} is an unsupported "
+                        f"pair — set {flip}, or run without the "
                         "participation window")
+        # Client-lifetime ledger (obs/ledger.py): fail-fast on a bad
+        # backend value, and name the one structurally impossible pair.
+        self.ledger_backend
+        if self.ledger_backend:
+            if self.num_devices and self.num_devices > 1:
+                raise ValueError(
+                    "ledger × num_devices>1 is an unsupported pair: the "
+                    "ledger folds per-lane diagnosis host-side and the "
+                    "mesh paths never materialise per-lane decisions — "
+                    "set .resources(num_devices=None), or flip "
+                    ".observability(ledger=False)"
+                )
+        elif self.ledger_dir:
+            raise ValueError(
+                "ledger_dir is set but the ledger is disabled — set "
+                ".observability(ledger='disk') (ledger_dir names the "
+                "disk backend's live directory) or drop ledger_dir"
+            )
         if self.client_packing not in ("off", "auto", None):
             # Forced int P: structural impossibilities fail at validate()
             # time, the same fail-fast discipline as faults/codecs.  The
@@ -794,6 +857,22 @@ class FedavgConfig:
                 f"evaluation_num_samples must be >= 1 (or None for the full "
                 f"per-client shard), got {self.evaluation_num_samples}"
             )
+
+    @property
+    def ledger_backend(self) -> Optional[str]:
+        """Normalized client-ledger request: ``None`` (off),
+        ``"resident"`` (host-RAM columns; also what ``ledger=True``
+        means) or ``"disk"`` (memmapped columns)."""
+        v = self.ledger
+        if v in (False, None, 0, "off", ""):
+            return None
+        if v in (True, 1, "on", "resident"):
+            return "resident"
+        if v == "disk":
+            return "disk"
+        raise ValueError(
+            f"ledger must be off|resident|disk (or bool), got {v!r}"
+        )
 
     @property
     def autotune_mode(self) -> Optional[str]:

@@ -33,6 +33,13 @@ from blades_tpu.data import DatasetCatalog
 from blades_tpu.obs.trace import Timers, now
 from blades_tpu.perf.async_metrics import DEVICE_METRICS_KEY
 
+#: Private row key carrying the round's cohort id-vector (+ per-event
+#: staleness on async rows) from _train_raw to _fill_round_metrics —
+#: stamped at dispatch time so DEFERRED rows (train_raw + flush) keep
+#: their own cohort even after the engine has moved on.  Popped before
+#: the row reaches any sink; never schema-visible.
+_COHORT_KEY = "_cohort_ids"
+
 
 class Fedavg:
     """FedAvg with Byzantine clients and a robust server."""
@@ -167,6 +174,7 @@ class Fedavg:
                 train_seed=int(cfg.seed),
                 fault_injector=cfg.get_fault_injector(),
                 state_store=self._state_store,
+                forensics=bool(cfg.forensics),
             )
             self.state = _dc_replace(
                 self.state,
@@ -289,6 +297,18 @@ class Fedavg:
             self._evaluate = jax.jit(self.fed_round.evaluate)
         else:
             self._setup_dense_pipeline()
+
+        # Client-lifetime ledger (obs/ledger.py): one longitudinal
+        # record per REGISTERED client, folded host-side in
+        # _fill_round_metrics from the already-fetched row and the
+        # round's cohort id-vector — zero extra device syncs.
+        self._ledger = None
+        if getattr(cfg, "ledger_backend", None):
+            from blades_tpu.obs.ledger import make_ledger
+
+            self._ledger = make_ledger(
+                cfg.ledger_backend, cfg.num_clients,
+                directory=getattr(cfg, "ledger_dir", None))
 
         self.timers = Timers()
         self._iteration = 0
@@ -962,6 +982,22 @@ class Fedavg:
         }
 
     @property
+    def client_ledger(self):
+        """The live :class:`~blades_tpu.obs.ledger.ClientLedger`, or
+        ``None`` when the ledger is off — the sweep attaches it to the
+        flight recorder so dumps carry the fleet fingerprint."""
+        return self._ledger
+
+    @property
+    def ledger_summary(self) -> Optional[Dict]:
+        """Client-ledger fleet digest for sweep summaries (backend,
+        clients seen, suspected fraction, reputation percentiles), or
+        ``None`` when the ledger is off."""
+        if self._ledger is None:
+            return None
+        return self._ledger.summary()
+
+    @property
     def packing_summary(self) -> Optional[Dict]:
         """The lane-packing decision get_fed_round() resolved for this
         trial (requested/pack_factor/packed_lanes/fallback reason), or
@@ -1076,6 +1112,18 @@ class Fedavg:
             row["buffer_overflow"] = int(info["buffer_overflow"])
             row["arrival_seed"] = int(info["arrival_seed"])
             row["updates_per_sec"] = round(info["events"] / elapsed, 3)
+            # Event cohort: lane i of this cycle's diag/metrics lanes is
+            # registered client last_clients[i].  Captured NOW so a
+            # deferred row keeps its own cohort after later cycles
+            # overwrite the engine's last_* columns.
+            row[_COHORT_KEY] = (
+                np.asarray(self._async.last_clients, np.int64),
+                np.asarray(self._async.last_staleness, np.int64))
+        elif self._state_pf is not None and self._window_prev is not None:
+            # Sampled window cohort: lane i diagnoses registered client
+            # _window_prev[0][i] (set by the round that just ran).
+            row[_COHORT_KEY] = (
+                np.asarray(self._window_prev[0], np.int64), None)
         if self._state_store is not None:
             # Participation-window staging digest (blades_tpu/state):
             # host counters the staging layer already holds — no device
@@ -1134,6 +1182,10 @@ class Fedavg:
         recovered by the last round).  ``idx=r``: round ``r``'s values
         from a stacked multi-round dispatch (the per-round rows of the
         sweep's scan-window path)."""
+        # The round's cohort id-vector (+ per-event staleness on async
+        # rows): stamped by _train_raw on the cohort-varying paths,
+        # identity arange on the dense full-participation round.
+        cohort_ids, cohort_staleness = row.pop(_COHORT_KEY, (None, None))
         metrics, lanes = {}, {}
         for k, v in raw.items():
             a = np.asarray(v)
@@ -1234,11 +1286,40 @@ class Fedavg:
             for k in ("byz_precision", "byz_recall", "byz_fpr"):
                 row[k] = metrics[k]
             row["num_flagged"] = int(metrics["num_flagged"])
+            if cohort_ids is None:
+                cohort_ids = np.arange(len(lanes["benign_mask"]),
+                                       dtype=np.int64)
+            # Cohort-shaped bundle: lane i diagnoses registered client
+            # clients[i] (the identity arange on dense rounds, so
+            # pre-cohort consumers read unchanged).
             row["lane_forensics"] = {
                 "benign_mask": [bool(b > 0.5) for b in lanes["benign_mask"]],
                 "healthy": [bool(h > 0.5) for h in lanes["healthy"]],
                 "scores": [float(s) for s in lanes["scores"]],
+                "clients": [int(c) for c in cohort_ids],
+                "update_norms": [float(x)
+                                 for x in lanes["update_norms"]],
             }
+        if self._ledger is not None:
+            # Client-lifetime ledger (obs/ledger.py): fold the round's
+            # cohort into the longitudinal records — host-side over the
+            # already-fetched lanes — then stamp the schema-registered
+            # fleet fields into the row.  Without forensics only
+            # participation/recency accrue (no diagnosis to fold).
+            if self.config.forensics:
+                flagged = np.asarray(lanes["benign_mask"]) <= 0.5
+                scores = np.asarray(lanes["scores"], np.float64)
+                norms = np.asarray(lanes["update_norms"], np.float64)
+            else:
+                flagged = scores = norms = None
+            if cohort_ids is None:
+                cohort_ids = np.arange(self.config.num_clients,
+                                       dtype=np.int64)
+            self._ledger.observe(
+                cohort_ids, round=int(row["training_iteration"]),
+                tick=row.get("tick"), flagged=flagged, scores=scores,
+                staleness=cohort_staleness, norms=norms)
+            row.update(self._ledger.round_fields())
 
     def train_rows(self, per_round: bool = False) -> List[Dict]:
         """One training dispatch, returned as result ROWS.
@@ -1392,6 +1473,10 @@ class Fedavg:
             pickle.dump(payload, f)
         if self._state_store is not None:
             self._state_store.save(path / "client_state")
+        if self._ledger is not None:
+            # Streaming shard checkpoint (ClientLedger.save: atomic per
+            # shard, manifest-last) — the same contract as client_state/.
+            self._ledger.save(path / "ledger")
         return str(file)
 
     def load_checkpoint(self, checkpoint_path: str) -> None:
@@ -1568,6 +1653,22 @@ class Fedavg:
             from blades_tpu.parallel import shard_federation
 
             state, _ = shard_federation(self.mesh, state, ())
+        if self._ledger is not None:
+            ledger_dir = p.parent / "ledger"
+            if (ledger_dir / "manifest.json").exists():
+                # Bit-identical longitudinal restore (sizes + CRCs
+                # validated per shard; LedgerError on a torn file).
+                self._ledger.load(ledger_dir)
+            else:
+                # Checkpoint from a ledger-less run: the records start
+                # cold at the restored round — participation counts
+                # before it are unrecoverable, and the warning says so.
+                warnings.warn(
+                    "checkpoint carries no ledger/ shards; the client "
+                    "ledger starts cold at round "
+                    f"{self._iteration} (longitudinal records before "
+                    "it are not recoverable)", RuntimeWarning,
+                    stacklevel=2)
         self.state = state
         if self._prefetcher is not None:
             # The key chain rewound: any staged batches belong to the
@@ -1581,3 +1682,5 @@ class Fedavg:
             self._state_pf.close()
         if self._state_store is not None:
             self._state_store.close()
+        if self._ledger is not None:
+            self._ledger.close()

@@ -65,7 +65,8 @@ def init_history(params, staleness_cap: int) -> jax.Array:
 
 def build_cycle(fed_round, *, staleness_cap: int, weight_schedule: str,
                 weight_power: float, weight_cutoff: int,
-                corrupt_mode=None, windowed_state: bool = False):
+                corrupt_mode=None, windowed_state: bool = False,
+                forensics: bool = False):
     """Build the pure cycle function for ``fed_round`` (jit the result).
 
     Returns ``cycle(state, data_x, data_y, lengths, ev_clients,
@@ -85,6 +86,15 @@ def build_cycle(fed_round, *, staleness_cap: int, weight_schedule: str,
     updating a full ``(n, ...)`` device stack in the traced program.
     The gathered rows are bit-equal to what the resident indexing
     reads, so both modes produce identical cycles.
+
+    ``forensics=True`` runs the aggregator's per-lane diagnostics on
+    the staleness-scaled event matrix (``Server.step_buffered_diag``)
+    and emits the cohort-shaped forensics bundle: the ``lane_*`` arrays
+    are indexed IN EVENT ORDER, so lane ``i`` diagnoses registered
+    client ``ev_clients[i]`` — the host driver stamps that id-vector
+    alongside as ``lane_forensics["clients"]``.  Detection P/R/FPR are
+    scored against the events' own malicious mask (every buffered row
+    was delivered, so no participation conditioning applies).
     """
     task = fed_round.task
     hooks = fed_round._hooks()
@@ -168,12 +178,25 @@ def build_cycle(fed_round, *, staleness_cap: int, weight_schedule: str,
                 )
         trusted_update = fed_round.compute_trusted_update(
             state.server.params, jax.random.fold_in(k_agg, 1))
+        if forensics:
+            # Non-destructive lane-health probe at the same pre-aggregate
+            # point the sync round takes it (post-corruption, post-forge:
+            # what the server is about to judge).
+            healthy = jnp.isfinite(updates).all(axis=-1)
+        diag = None
         with jax.named_scope("blades/aggregate"):
-            server, agg = fed_round.server.step_buffered(
-                state.server, updates, staleness=ev_stale, key=k_agg,
-                trusted_update=trusted_update, schedule=weight_schedule,
-                power=weight_power, cutoff=weight_cutoff,
-            )
+            if forensics:
+                server, agg, diag = fed_round.server.step_buffered_diag(
+                    state.server, updates, staleness=ev_stale, key=k_agg,
+                    trusted_update=trusted_update, schedule=weight_schedule,
+                    power=weight_power, cutoff=weight_cutoff,
+                )
+            else:
+                server, agg = fed_round.server.step_buffered(
+                    state.server, updates, staleness=ev_stale, key=k_agg,
+                    trusted_update=trusted_update, schedule=weight_schedule,
+                    power=weight_power, cutoff=weight_cutoff,
+                )
         ravel, _, _ = ravel_fn(server.params)
         hist = jnp.concatenate([ravel(server.params)[None], hist[:-1]],
                                axis=0)
@@ -194,6 +217,22 @@ def build_cycle(fed_round, *, staleness_cap: int, weight_schedule: str,
             "agg_norm": jnp.linalg.norm(agg),
             "round": server.round,
         }
+        if forensics:
+            from blades_tpu.obs.forensics import detection_metrics
+
+            # Cohort-shaped forensics: lane i diagnoses registered
+            # client ev_clients[i].  Same "lane_" bundle contract as the
+            # sync round (f32 for uniform scan stacking); the driver
+            # pairs it with the event id-vector.
+            metrics.update(detection_metrics(diag["benign_mask"],
+                                             ev_malicious))
+            metrics["num_unhealthy"] = (~healthy).sum()
+            metrics["lane_benign_mask"] = diag["benign_mask"].astype(
+                jnp.float32)
+            metrics["lane_scores"] = diag["scores"].astype(jnp.float32)
+            metrics["lane_healthy"] = healthy.astype(jnp.float32)
+            metrics["lane_update_norms"] = jnp.linalg.norm(
+                updates, axis=1).astype(jnp.float32)
         return RoundState(
             server=server, client_opt=client_opt,
             stale=getattr(state, "stale", None),

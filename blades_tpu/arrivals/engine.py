@@ -130,7 +130,8 @@ class AsyncEngine:
     """Host driver pairing an :class:`AsyncSpec` with a ``FedRound``."""
 
     def __init__(self, fed_round, spec: AsyncSpec, num_clients: int, *,
-                 train_seed: int, fault_injector=None, state_store=None):
+                 train_seed: int, fault_injector=None, state_store=None,
+                 forensics: bool = False):
         if spec.agg_every > num_clients:
             raise ValueError(
                 f"agg_every={spec.agg_every} > num_clients={num_clients}: "
@@ -165,6 +166,7 @@ class AsyncEngine:
             weight_cutoff=spec.weight_cutoff,
             corrupt_mode=corrupt_mode,
             windowed_state=state_store is not None,
+            forensics=forensics,
         ))
         # Per-event training keys fold (seed, tick, client) off this base
         # — the async analogue of the sync driver's split chain, with no
@@ -182,6 +184,14 @@ class AsyncEngine:
         self.arrivals_dropped = 0          # chaos dropout (never buffered)
         self.buffer_overflow = 0           # full-buffer drops
         self.last_info: Dict[str, Any] = {}
+        # The LAST cycle's event cohort, host-side — the id-vector
+        # cohort-shaped forensics lanes are indexed by (lane i of the
+        # diag arrays is registered client last_clients[i]) and the
+        # per-event staleness the client ledger folds in.  Derived from
+        # the same deterministic event columns run_cycle already builds,
+        # so they replay identically across kill-and-resume.
+        self.last_clients: Any = None      # (K,) np.int32 registered ids
+        self.last_staleness: Any = None    # (K,) np.int32 staleness
 
     # -- realization ---------------------------------------------------------
 
@@ -332,6 +342,8 @@ class AsyncEngine:
                 jnp.asarray(corrupt), self._key_base, k_agg,
             )
         self.version += 1
+        self.last_clients = clients
+        self.last_staleness = staleness
 
         hist = np.bincount(
             np.clip(staleness, 0, spec.staleness_cap + 1),

@@ -529,6 +529,11 @@ class FedRound:
             metrics["lane_benign_mask"] = diag["benign_mask"].astype(jnp.float32)
             metrics["lane_scores"] = diag["scores"].astype(jnp.float32)
             metrics["lane_healthy"] = healthy_mask.astype(jnp.float32)
+            # Per-lane update norms (post-forge: the rows the aggregator
+            # judged) — the client ledger's longitudinal norm stream.
+            # Purely additional output: masks/scores above are untouched.
+            metrics["lane_update_norms"] = jnp.linalg.norm(
+                updates, axis=1).astype(jnp.float32)
         return RoundState(server=server, client_opt=client_opt, stale=stale,
                           residual=residual,
                           arrivals=getattr(state, "arrivals", None),

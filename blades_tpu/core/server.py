@@ -201,6 +201,39 @@ class Server:
         return self.step(state, scaled, key=key,
                          trusted_update=trusted_update)
 
+    def step_buffered_diag(
+        self,
+        state: ServerState,
+        updates: jax.Array,
+        *,
+        staleness: jax.Array,
+        key: Optional[jax.Array] = None,
+        trusted_update: Optional[jax.Array] = None,
+        schedule: str = "polynomial",
+        power: float = 0.5,
+        cutoff: int = 16,
+    ) -> Tuple[ServerState, jax.Array, dict]:
+        """:meth:`step_buffered` plus the per-lane diagnostics bundle —
+        ``(new_state, aggregate, diag)``, the buffered-async twin of
+        :meth:`step_diag`.  The diag lanes cover the ``(K,)`` buffer
+        rows IN EVENT ORDER, so the host engine's event client-id
+        vector re-indexes them to registered clients.  Diagnosis runs
+        on the staleness-SCALED rows — the matrix the aggregator
+        actually judged, so mask/scores describe the aggregation that
+        happened.  With the ``constant`` schedule the scale is exactly
+        1 and this IS :meth:`step_diag`, bit for bit.
+        """
+        from blades_tpu.arrivals.weights import (
+            normalized_row_scale,
+            staleness_weights,
+        )
+
+        w = staleness_weights(schedule, staleness, power=power,
+                              cutoff=cutoff)
+        scaled = updates * normalized_row_scale(w)[:, None]
+        return self.step_diag(state, scaled, key=key,
+                              trusted_update=trusted_update)
+
     def step_wire(
         self,
         state: ServerState,

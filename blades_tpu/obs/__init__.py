@@ -24,6 +24,12 @@ multi-chip work will be debugged with):
   rules over the already-fetched rows (NaN aggregate, norm spike,
   FPR collapse, rounds/s regression), emitting ``watchdog_events`` and
   triggering the flight-recorder dump.
+- **client ledger** (:mod:`blades_tpu.obs.ledger`): ONE longitudinal
+  record per registered client (participation/flagged counts,
+  detection-score EWMA, staleness/norm running stats), updated
+  host-side from cohort-indexed diagnosis lanes with resident and
+  disk-memmap backends, streaming shard checkpoints, and the
+  ``tools/ledger_report.py`` query CLI.
 """
 
 from blades_tpu.obs.flightrec import (  # noqa: F401
@@ -31,6 +37,15 @@ from blades_tpu.obs.flightrec import (  # noqa: F401
     validate_flightrec,
 )
 from blades_tpu.obs.forensics import detection_metrics  # noqa: F401
+from blades_tpu.obs.ledger import (  # noqa: F401
+    ClientLedger,
+    DiskLedger,
+    LedgerError,
+    ResidentLedger,
+    make_ledger,
+    read_ledger,
+    validate_ledger_checkpoint,
+)
 from blades_tpu.obs.metrics import (  # noqa: F401
     CsvSink,
     JsonlSink,

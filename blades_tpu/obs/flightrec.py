@@ -52,6 +52,10 @@ REPLAY_FIELDS = (
     "num_flagged",
     "tick", "staleness_mean", "staleness_max", "buffer_fill",
     "buffer_overflow", "arrivals_dropped",
+    # Client-ledger fleet fields (obs/ledger.py) — pure functions of
+    # the diagnosis stream, so replay reproduces them bit-for-bit.
+    "suspected_fraction", "flagged_churn", "reputation_p10",
+    "reputation_p50", "reputation_p90", "ledger_clients_seen",
 )
 
 #: Wall-clock / run-shape fields dropped from digests — they vary run to
@@ -101,6 +105,12 @@ class FlightRecorder:
         self._ring: deque = deque(maxlen=self.capacity)
         self._dumped_kinds: set = set()
         self.dumps = 0
+        # Optional ClientLedger handle (obs/ledger.py): when the sweep
+        # attaches one, every dump carries the fleet fingerprint
+        # (ledger.digest(): seen/flagged totals + column CRC32) so a
+        # forensic dump identifies WHICH longitudinal state it was
+        # taken against, not just which round.
+        self.ledger = None
 
     # -- recording -----------------------------------------------------------
 
@@ -148,6 +158,12 @@ class FlightRecorder:
         return atomic_write_json(self.as_dump(trigger), self.path)
 
     def as_dump(self, trigger: Dict[str, Any]) -> Dict[str, Any]:
+        ledger_digest = None
+        if self.ledger is not None:
+            try:
+                ledger_digest = self.ledger.digest()
+            except Exception as exc:  # a torn ledger must not lose the dump
+                ledger_digest = {"error": f"{type(exc).__name__}: {exc}"}
         return {
             "version": FLIGHTREC_VERSION,
             "experiment": self.experiment,
@@ -169,6 +185,7 @@ class FlightRecorder:
             "max_rounds": self.max_rounds,
             "config": self.config,
             "capacity": self.capacity,
+            "ledger": ledger_digest,
             "rounds": list(self._ring),
         }
 

@@ -174,15 +174,38 @@ ROUND_RECORD_FIELDS: Dict[str, Tuple[tuple, bool]] = {
     "byz_fpr": (_NUM, False),
     "num_flagged": ((int,), False),
     "lane_forensics": ((dict,), False),
+    # Client-lifetime ledger (obs/ledger.py): fleet-level longitudinal
+    # telemetry stamped host-side on ledger-armed rounds.
+    # suspected_fraction = seen clients whose lifetime flag rate
+    # exceeds 0.5; flagged_churn = cohort clients whose flag status
+    # flipped vs their OWN previous participation; reputation_p* are
+    # percentiles of (1 - lifetime flag rate) over seen clients —
+    # reputation_collapse / flagger_churn watchdog rules watch them.
+    # ledger_top_suspects is list-typed (client ids; the CSV sink
+    # skips it like watchdog_events).
+    "suspected_fraction": (_NUM, False),
+    "flagged_churn": ((int,), False),
+    "reputation_p10": (_NUM, False),
+    "reputation_p50": (_NUM, False),
+    "reputation_p90": (_NUM, False),
+    "ledger_clients_seen": ((int,), False),
+    "ledger_top_suspects": ((list,), False),
     # host-side timings (utils/timers.py)
     "timers": ((dict,), False),
 }
 
-# lane_forensics sub-keys -> allowed element types
+# lane_forensics sub-keys -> allowed element types.  `clients` is the
+# round's cohort id-vector: lane i of every other array diagnoses
+# registered client clients[i] (dense full-participation rounds stamp
+# the identity arange, so pre-cohort consumers read unchanged).
+# `update_norms` are the per-lane post-corruption update L2 norms the
+# ledger folds into its longitudinal running stats.
 _LANE_FIELDS: Dict[str, tuple] = {
     "benign_mask": (bool,),
     "healthy": (bool,),
     "scores": _NUM,
+    "clients": (int,),
+    "update_norms": _NUM,
 }
 
 
@@ -239,6 +262,12 @@ def validate_record(record: Any) -> Dict[str, Any]:
             if not _type_ok(v, (int,)):
                 problems.append(f"staleness_hist[{i}] must be an int "
                                 f"bucket count, got {type(v).__name__}")
+    suspects = record.get("ledger_top_suspects")
+    if isinstance(suspects, list):
+        for i, v in enumerate(suspects):
+            if not _type_ok(v, (int,)):
+                problems.append(f"ledger_top_suspects[{i}] must be an "
+                                f"int client id, got {type(v).__name__}")
     if problems:
         raise SchemaError("; ".join(problems))
     return record

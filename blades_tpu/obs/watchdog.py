@@ -128,6 +128,25 @@ def default_rules() -> tuple:
         WatchdogRule(name="ingest_collapse", kind="collapse",
                      field="updates_per_sec", window=8, min_points=4,
                      factor=4.0),
+        # Client-lifetime ledger (obs/ledger.py): reputation drift.
+        # Inert unless the ledger stamps its fields (absent => skipped).
+        # reputation_collapse: the fleet's median reputation fell off a
+        # cliff vs its own rolling history — the defense started
+        # flagging broad swaths of the registered population (an
+        # adaptive attack dragging benign clients across the detection
+        # boundary, or a detection regression).  factor is tight (2x)
+        # because reputation is a slow lifetime average: halving the
+        # median in one window is already catastrophic.
+        WatchdogRule(name="reputation_collapse", kind="collapse",
+                     field="reputation_p50", window=8, min_points=4,
+                     factor=2.0),
+        # flagger_churn: the set of flagged clients is thrashing —
+        # many clients flipping flag status per round vs the rolling
+        # median churn (BLADE-FL-style intermittent attackers toggling
+        # in and out of detection, or an unstable defense boundary).
+        WatchdogRule(name="flagger_churn", kind="spike",
+                     field="flagged_churn", window=8, min_points=4,
+                     factor=4.0),
     )
 
 

@@ -1015,6 +1015,11 @@ def run_experiments(
                 experiment=exp_name, trial=tname, algo=spec["run"],
                 config=trial_cfg, max_rounds=max_rounds)
                 if flightrec_rounds else None)
+            if flightrec is not None:
+                # Hand the recorder the trial's client ledger (if armed):
+                # dumps then carry a shard-wise CRC digest of the
+                # longitudinal records at crash time.
+                flightrec.ledger = getattr(algo, "client_ledger", None)
             if resumed_from and (wd is not None or flightrec is not None):
                 surviving = _read_results(tdir / "result.json")
                 if wd is not None:
@@ -1227,6 +1232,12 @@ def run_experiments(
                     _truncate_results(tdir / "result.json", algo.iteration)
                     _truncate_results(tdir / "metrics.jsonl", algo.iteration)
                     _truncate_csv(tdir / "metrics.csv", algo.iteration)
+                    if flightrec is not None:
+                        # The rebuilt algorithm owns a fresh ledger
+                        # (restored from the checkpoint above); re-point
+                        # the recorder at it or dumps digest a dead one.
+                        flightrec.ledger = getattr(
+                            algo, "client_ledger", None)
                     if wd is not None or flightrec is not None:
                         # Replay the surviving rows into the rolling
                         # windows / the digest ring: the restarted trial
@@ -1337,6 +1348,12 @@ def run_experiments(
                 # backend + window + the staging peak, mirrored from the
                 # row stamps like the comm/arrivals blocks.
                 summary["state_store"] = state_block
+            ledger_block = getattr(algo, "ledger_summary", None)
+            if ledger_block:
+                # Client-lifetime ledger (blades_tpu/obs/ledger): fleet
+                # telemetry — clients seen, flagged fractions, top
+                # suspects — folded into the trial summary.
+                summary["ledger"] = ledger_block
             if hasattr(algo, "stop"):
                 # Release trial-scoped resources (the window store's
                 # temp/memmap directories, the staging worker); the

@@ -1,0 +1,25 @@
+"""Seeded ledger-discipline violations (blades-lint fixture, never
+imported): device fetches inside a ledger-style per-round update —
+the observe() path must consume ALREADY-FETCHED host rows, never pull
+from the device itself.  Scanned only when the test instantiates
+HostSyncPass with this path in its module list (the real pass scans
+blades_tpu/obs/ledger.py via DEVICE_SIDE)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def leaky_observe(ledger, diag, updates):
+    flagged = np.asarray(diag["benign_mask"] <= 0.5)  # BAD: fetches the device mask on the driver thread
+    scores = jax.device_get(diag["scores"])  # BAD: per-round device_get outside the batched flush
+    norms = jnp.linalg.norm(updates, axis=1)
+    worst = float(norms.max())  # BAD: blocks the dispatch pipeline on a reduction
+    ledger.observe(np.arange(len(scores)), round=0,
+                   flagged=flagged, scores=scores)
+    return worst
+
+
+def leaky_round_fields(ledger, last_agg):
+    last_agg.block_until_ready()  # BAD: queue drain before a fleet stat
+    seen = int(jnp.count_nonzero(last_agg))  # BAD: int() on a device expression
+    return {"ledger_clients_seen": seen}

@@ -1,0 +1,17 @@
+"""Clean ledger-update code (blades-lint fixture, never imported): the
+sanctioned boundary coerces rows the driver ALREADY fetched — numpy in,
+numpy out, with justification pragmas on the coercion lines — and the
+fleet stats reduce host columns, not device arrays."""
+import numpy as np
+
+
+def disciplined_observe(ledger, row_lanes, cohort_ids):
+    ids = np.asarray(cohort_ids, np.int64)  # blades-lint: disable=host-sync — sanctioned ledger boundary: cohort ids arrive as already-fetched host data
+    flagged = np.asarray(row_lanes["benign_mask"], np.float64) <= 0.5  # blades-lint: disable=host-sync — sanctioned ledger boundary: the mask is a slice of the row the driver already fetched
+    scores = np.asarray(row_lanes["scores"], np.float64)  # blades-lint: disable=host-sync — sanctioned ledger boundary: already-fetched row slice
+    ledger.observe(ids, round=0, flagged=flagged, scores=scores)
+
+
+def disciplined_fleet_view(participation):
+    seen = participation > 0  # host column: ledger state never lives on device
+    return {"ledger_clients_seen": int(seen.sum())}  # blades-lint: disable=host-sync — sanctioned ledger boundary: numpy reduction over a host column

@@ -224,8 +224,9 @@ def test_config_gates():
 
     with pytest.raises(ValueError, match="async_config is set"):
         FedavgConfig().arrivals(rate=0.5).validate()
-    with pytest.raises(ValueError, match="forensics"):
-        _async_config(forensics=True).validate()
+    # Forensics composes since the cohort-shaped re-index (ISSUE 16):
+    # the buffered cycle diagnoses the staleness-scaled event matrix.
+    _async_config(forensics=True).validate()
     with pytest.raises(ValueError, match="codec"):
         _async_config(codec_config={"type": "quant", "bits": 8}).validate()
     with pytest.raises(ValueError, match="agg_every"):

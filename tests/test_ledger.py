@@ -1,0 +1,787 @@
+"""Client-lifetime ledger tests (blades_tpu/obs/ledger.py): the
+longitudinal per-client record fold, backend/checkpoint parity, the
+cohort-shaped integration across the dense, windowed and buffered-async
+paths, and the fleet-view surfaces (watchdog rules, flight-recorder
+digests, report CLI).
+
+The acceptance contracts under test:
+
+- dense full-participation diagnosis is BIT-identical with the ledger
+  armed (the ledger is a pure host-side consumer of already-fetched
+  lanes);
+- cohort-shaped rounds (windowed / async) map lane decisions back to
+  the correct registered client ids, and the ledger's lifetime counts
+  reconcile exactly with the per-row lane stream;
+- a 100k-registered disk ledger runs under a bounded host-memory
+  ceiling (memmapped columns — page cache, not RSS);
+- kill-and-resume restores the ledger bit-identically through the
+  faults harness (streaming CRC-verified shard checkpoints).
+"""
+
+import json
+import tracemalloc
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from blades_tpu.obs.ledger import (
+    DEFAULT_SHARD_ROWS,
+    LEDGER_COLUMNS,
+    LEDGER_EWMA_ALPHA,
+    LedgerError,
+    make_ledger,
+    read_ledger,
+    validate_ledger_checkpoint,
+)
+
+N = 8  # tiny-federation size for the driver tests
+
+
+# ---------------------------------------------------------------------------
+# observe(): the one cohort-shaped update per round
+# ---------------------------------------------------------------------------
+
+
+def test_observe_counts_recency_and_first_participation():
+    led = make_ledger("resident", N)
+    led.observe([0, 2, 5], round=1, tick=7)
+    for cid, expect in ((0, 1), (1, 0), (2, 1), (5, 1)):
+        rec = led.client_record(cid)
+        assert rec["participation"] == expect
+        assert rec["last_round"] == (1 if expect else -1)
+        assert rec["last_tick"] == (7 if expect else -1)
+    led.observe([2], round=4)
+    rec = led.client_record(2)
+    assert rec["participation"] == 2 and rec["last_round"] == 4
+    # tick omitted: recency keeps the last stamped value.
+    assert rec["last_tick"] == 7
+
+
+def test_score_ewma_first_sample_then_exact_binary_update():
+    led = make_ledger("resident", N)
+    led.observe([3], round=1, scores=[2.0])
+    assert led.client_record(3)["score_ewma"] == 2.0  # first = raw score
+    led.observe([3], round=2, scores=[4.0])
+    a = LEDGER_EWMA_ALPHA
+    assert a == 0.125  # power of two -> the update below is exact
+    assert led.client_record(3)["score_ewma"] == (1 - a) * 2.0 + a * 4.0
+
+
+def test_welford_running_stats_match_two_sample_population():
+    led = make_ledger("resident", N)
+    led.observe([1], round=1, staleness=[1.0], norms=[10.0])
+    led.observe([1], round=2, staleness=[3.0], norms=[20.0])
+    rec = led.client_record(1)
+    assert rec["stale_count"] == 2
+    assert rec["stale_mean"] == 2.0
+    assert rec["stale_var"] == 1.0  # population variance of {1, 3}
+    assert rec["norm_count"] == 2
+    assert rec["norm_mean"] == 15.0
+    assert rec["norm_var"] == 25.0
+
+
+def test_flagged_churn_is_vs_each_clients_own_history():
+    led = make_ledger("resident", N)
+    # First-timers baseline "not flagged": two of three flip on entry.
+    led.observe([0, 1, 2], round=1, flagged=[True, True, False])
+    assert led.round_fields()["flagged_churn"] == 2
+    # Client 1 flips back; 0 and 2 hold steady.
+    led.observe([0, 1, 2], round=2, flagged=[True, False, False])
+    assert led.round_fields()["flagged_churn"] == 1
+    rec0 = led.client_record(0)
+    assert rec0["flagged"] == 2 and rec0["last_flagged"] is True
+    rec1 = led.client_record(1)
+    assert rec1["flagged"] == 1 and rec1["last_flagged"] is False
+
+
+def test_round_fields_fleet_statistics_and_top_suspects():
+    led = make_ledger("resident", N)
+    led.observe([0, 1, 2, 3], round=1, flagged=[1, 1, 1, 0],
+                scores=[5.0, 1.0, 2.0, 0.0])
+    led.observe([0, 1], round=2, flagged=[0, 1], scores=[0.0, 1.0])
+    rf = led.round_fields()
+    # flag rates: 0 -> 0.5, 1 -> 1.0, 2 -> 1.0, 3 -> 0.0
+    assert rf["ledger_clients_seen"] == 4
+    assert rf["suspected_fraction"] == 0.5  # ids 1, 2 of 4 seen
+    rep = np.array([0.5, 0.0, 0.0, 1.0])  # 1 - lifetime flag rate
+    for q, key in ((10, "reputation_p10"), (50, "reputation_p50"),
+                   (90, "reputation_p90")):
+        assert rf[key] == pytest.approx(float(np.percentile(rep, q)))
+    # Rate ties broken by score EWMA (id 2 ewma 2.0 > id 1 ewma 1.0),
+    # zero-flag-rate clients never listed as suspects.
+    assert rf["ledger_top_suspects"] == [2, 1, 0]
+    sus = led.top_suspects(2)
+    assert [r["client"] for r in sus] == [2, 1]
+    assert sus[0]["flag_rate"] == 1.0
+    summary = led.summary()
+    assert summary["backend"] == "resident"
+    assert summary["clients_seen"] == 4 and summary["total_flagged"] == 4
+    assert summary["total_bytes"] == led.row_bytes * N
+
+
+def test_empty_ledger_round_fields_are_inert():
+    rf = make_ledger("resident", N).round_fields()
+    assert rf["ledger_clients_seen"] == 0
+    assert rf["suspected_fraction"] == 0.0
+    assert rf["ledger_top_suspects"] == []
+    assert rf["reputation_p50"] == 1.0
+
+
+def test_observe_rejects_malformed_cohorts():
+    led = make_ledger("resident", N)
+    with pytest.raises(LedgerError, match="non-empty 1-D"):
+        led.observe([], round=1)
+    with pytest.raises(LedgerError, match="non-empty 1-D"):
+        led.observe([[0, 1]], round=1)
+    with pytest.raises(LedgerError, match="out of range"):
+        led.observe([0, N], round=1)
+    with pytest.raises(LedgerError, match="out of range"):
+        led.observe([-1], round=1)
+    with pytest.raises(LedgerError, match="duplicates"):
+        led.observe([0, 3, 3], round=1)
+    with pytest.raises(LedgerError, match="out of range"):
+        led.client_record(N)
+    with pytest.raises(ValueError, match="backend"):
+        make_ledger("hbm", N)
+
+
+# ---------------------------------------------------------------------------
+# backends: resident vs disk parity, checkpoint roundtrip + chaos
+# ---------------------------------------------------------------------------
+
+
+def _fold_cohorts(led):
+    led.observe([0, 2, 5], round=1, tick=3, flagged=[1, 0, 1],
+                scores=[2.0, -1.0, 0.5], staleness=[0, 1, 2],
+                norms=[1.0, 2.0, 3.0])
+    led.observe([1, 2], round=2, tick=5, flagged=[0, 1],
+                scores=[0.25, 4.0], staleness=[1, 0], norms=[5.0, 0.5])
+    return led
+
+
+def test_disk_backend_matches_resident_bit_for_bit(tmp_path):
+    res = _fold_cohorts(make_ledger("resident", N))
+    disk = _fold_cohorts(make_ledger("disk", N,
+                                     directory=str(tmp_path / "led")))
+    d_res, d_disk = res.digest(), disk.digest()
+    assert d_res.pop("backend") == "resident"
+    assert d_disk.pop("backend") == "disk"
+    assert d_res == d_disk  # totals AND the full-column CRC32
+    assert disk.host_bytes() == 0  # memmaps: page cache, not RSS
+    assert res.host_bytes() == res.total_bytes()
+    for cid in range(N):
+        assert res.client_record(cid) == disk.client_record(cid)
+    disk.close()
+    assert (tmp_path / "led").exists()  # caller-owned dir survives close
+
+
+def test_disk_ledger_owns_and_removes_its_temp_dir():
+    led = make_ledger("disk", N)
+    private = led._dir
+    assert private.exists()
+    led.observe([0], round=1)
+    led.close()
+    assert not private.exists()
+
+
+def test_checkpoint_roundtrip_and_cross_backend_restore(tmp_path):
+    led = _fold_cohorts(make_ledger("resident", N))
+    ck = tmp_path / "ledger"
+    led.save(ck, shard_rows=3)  # 3 shards -> multi-shard layout on CPU
+    num_ok, errors = validate_ledger_checkpoint(ck)
+    assert errors == []
+    assert num_ok == 3 * len(LEDGER_COLUMNS)
+    # read_ledger materialises a ResidentLedger regardless of writer.
+    back = read_ledger(ck)
+    assert back.digest()["crc32"] == led.digest()["crc32"]
+    assert back.client_record(5) == led.client_record(5)
+    # The same shard set restores under the disk backend.
+    disk = make_ledger("disk", N, directory=str(tmp_path / "live"))
+    disk.load(ck)
+    assert disk.digest()["crc32"] == led.digest()["crc32"]
+    disk.close()
+    # Population mismatch is a refusal, not a silent partial restore.
+    with pytest.raises(LedgerError, match="registered clients"):
+        make_ledger("resident", N + 1).load(ck)
+
+
+def test_checkpoint_chaos_torn_corrupt_missing(tmp_path):
+    led = _fold_cohorts(make_ledger("resident", N))
+    ck = tmp_path / "ledger"
+    led.save(ck, shard_rows=4)
+
+    # Torn shard (size mismatch): reported, named, and load() refuses.
+    victim = ck / "shard-00000.l03.npy"
+    data = victim.read_bytes()
+    victim.write_bytes(data[:-5])
+    _, errors = validate_ledger_checkpoint(ck)
+    assert any("torn shard" in e and victim.name in e for e in errors)
+    with pytest.raises(LedgerError, match="torn"):
+        make_ledger("resident", N).load(ck)
+
+    # Same size, flipped payload byte: the CRC catches it.
+    corrupt = bytearray(data)
+    corrupt[-1] ^= 0xFF
+    victim.write_bytes(bytes(corrupt))
+    _, errors = validate_ledger_checkpoint(ck)
+    assert any("CRC32 mismatch" in e for e in errors)
+    with pytest.raises(LedgerError, match="CRC32"):
+        make_ledger("resident", N).load(ck)
+    victim.write_bytes(data)
+
+    # Missing shard file.
+    gone = ck / "shard-00001.l00.npy"
+    gone.unlink()
+    _, errors = validate_ledger_checkpoint(ck)
+    assert any("missing shard file" in e for e in errors)
+    with pytest.raises(LedgerError, match="missing shard"):
+        make_ledger("resident", N).load(ck)
+
+    # Manifest drift: an entry naming a file outside the layout.
+    manifest = json.loads((ck / "manifest.json").read_text())
+    manifest["files"]["shard-00099.l00.npy"] = {"bytes": 1, "crc32": 0}
+    (ck / "manifest.json").write_text(json.dumps(manifest))
+    _, errors = validate_ledger_checkpoint(ck)
+    assert any("not part of the shard layout" in e for e in errors)
+
+    # No manifest at all: the shard set was never published.
+    (ck / "manifest.json").unlink()
+    num_ok, errors = validate_ledger_checkpoint(ck)
+    assert num_ok == 0 and "no manifest.json" in errors[0]
+    with pytest.raises(LedgerError, match="manifest"):
+        read_ledger(ck)
+
+
+def test_save_is_rerunnable_and_clears_orphaned_tmps(tmp_path):
+    led = _fold_cohorts(make_ledger("resident", N))
+    ck = tmp_path / "ledger"
+    led.save(ck)
+    (ck / "shard-00000.l00.npy.tmp").write_bytes(b"interrupted")
+    led.observe([4], round=3)
+    led.save(ck)  # overwrite in place, orphan deleted
+    assert not list(ck.glob("*.tmp"))
+    assert validate_ledger_checkpoint(ck)[1] == []
+    assert read_ledger(ck).client_record(4)["participation"] == 1
+
+
+# ---------------------------------------------------------------------------
+# offline CLIs: validate_metrics --ledger, ledger_report
+# ---------------------------------------------------------------------------
+
+
+def test_validate_metrics_ledger_mode(tmp_path, capsys):
+    from tools.validate_metrics import main as vm
+
+    led = _fold_cohorts(make_ledger("resident", N))
+    ck = tmp_path / "ledger"
+    led.save(ck)
+    assert vm(["--ledger", str(ck)]) == 0
+    out = capsys.readouterr().out
+    assert "valid shard file(s), 0 error(s)" in out
+
+    # Orphaned .tmp inside the directory: noted, still rc 0 (the
+    # published shard set next to it is complete).
+    (ck / "manifest.json.tmp").write_bytes(b"x")
+    assert vm(["--ledger", str(ck)]) == 0
+    assert "orphaned manifest.json.tmp" in capsys.readouterr().out
+    (ck / "manifest.json.tmp").unlink()
+
+    # A torn shard is a reported error and a nonzero exit.
+    victim = ck / "shard-00000.l00.npy"
+    victim.write_bytes(victim.read_bytes()[:-3])
+    assert vm(["--ledger", str(ck)]) == 1
+    assert "torn shard" in capsys.readouterr().out
+    assert vm(["--ledger", str(tmp_path / "nope")]) == 1
+
+
+def test_ledger_report_fleet_and_client_views(tmp_path, capsys):
+    from tools.ledger_report import main as report
+
+    led = _fold_cohorts(make_ledger("resident", N))
+    ck = tmp_path / "ledger"
+    led.save(ck)
+
+    assert report([str(ck)]) == 0
+    out = capsys.readouterr().out
+    assert f"{N} registered, 4 seen" in out
+    assert "suspected_fraction" in out and "top" in out
+
+    assert report([str(ck), "--json", "--top", "2"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["summary"]["clients_seen"] == 4
+    assert len(payload["top_suspects"]) == 2
+    assert payload["top_suspects"][0]["flag_rate"] == 1.0
+
+    # Per-client view joined against a cohort-shaped metrics stream:
+    # membership in lane_forensics["clients"], not lane position.
+    metrics = tmp_path / "metrics.jsonl"
+    rows = [
+        {"training_iteration": 1, "tick": 3,
+         "lane_forensics": {"clients": [0, 2, 5],
+                            "benign_mask": [False, True, False],
+                            "scores": [2.0, -1.0, 0.5],
+                            "update_norms": [1.0, 2.0, 3.0]}},
+        {"training_iteration": 2,
+         "lane_forensics": {"clients": [1, 2],
+                            "benign_mask": [True, False],
+                            "scores": [0.25, 4.0],
+                            "update_norms": [5.0, 0.5]}},
+        {"training_iteration": 3, "train_loss": 0.1},  # no lanes: skipped
+    ]
+    metrics.write_text("\n".join(json.dumps(r) for r in rows)
+                       + "\n{torn line")
+    assert report([str(ck), "--client", "2", "--metrics", str(metrics),
+                   "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["record"]["participation"] == 2
+    tl = payload["timeline"]
+    assert [ev["round"] for ev in tl] == [1, 2]
+    assert [ev["flagged"] for ev in tl] == [False, True]
+    assert tl[0]["tick"] == 3 and tl[1]["update_norm"] == 0.5
+
+    assert report([str(ck), "--client", "2", "--metrics",
+                   str(metrics)]) == 0
+    out = capsys.readouterr().out
+    assert "timeline (2 diagnosed round(s)" in out and "FLAGGED" in out
+
+    assert report([str(ck), "--client", str(N)]) == 1  # out of range
+    assert report([str(tmp_path / "nope")]) == 1  # no manifest
+    assert "manifest" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# config surface
+# ---------------------------------------------------------------------------
+
+
+def _base_cfg(**overrides):
+    from blades_tpu.algorithms.config import FedavgConfig
+
+    cfg = (FedavgConfig()
+           .data(dataset="mnist", num_clients=N, seed=3)
+           .training(global_model="mlp",
+                     aggregator={"type": "Median"}))
+    for k, v in overrides.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+def test_ledger_backend_normalization_and_gates():
+    cfg = _base_cfg()
+    for raw, want in ((False, None), (None, None), ("off", None),
+                      ("", None), (True, "resident"),
+                      ("resident", "resident"), ("disk", "disk")):
+        cfg.ledger = raw
+        assert cfg.ledger_backend == want
+    cfg.ledger = "hbm"
+    with pytest.raises(ValueError, match="off|resident|disk"):
+        cfg.ledger_backend
+
+    _base_cfg().observability(ledger=True).validate()
+    with pytest.raises(ValueError, match="unsupported pair"):
+        _base_cfg(num_devices=2).observability(ledger=True).validate()
+    with pytest.raises(ValueError, match="ledger_dir"):
+        _base_cfg().observability(ledger_dir="/tmp/led").validate()
+
+
+# ---------------------------------------------------------------------------
+# dense integration: forensics equivalence + armed row fields
+# ---------------------------------------------------------------------------
+
+N_CLIENTS, N_BYZ = 10, 3
+
+
+def _dense_cfg(ledger=False, seed=3):
+    from blades_tpu.algorithms import get_algorithm_class
+
+    _, cfg = get_algorithm_class("FEDAVG", return_config=True)
+    cfg.update_from_dict({
+        "dataset_config": {"type": "mnist", "num_clients": N_CLIENTS,
+                           "train_bs": 8, "seed": seed},
+        "global_model": "mlp",
+        "evaluation_interval": 10,
+        "num_malicious_clients": N_BYZ,
+        "adversary_config": {"type": "ALIE"},
+        "server_config": {"lr": 1.0, "aggregator": "Median"},
+        "forensics": True,
+        "ledger": ledger,
+    })
+    return cfg
+
+
+def test_dense_diagnosis_bit_identical_with_ledger_armed():
+    """Acceptance: arming the ledger must not perturb training or the
+    diagnosis — it is a pure host-side consumer of the fetched lanes."""
+    from blades_tpu.obs import validate_record
+
+    bare = _dense_cfg(ledger=False).build()
+    armed = _dense_cfg(ledger=True).build()
+    for rnd in range(1, 4):
+        r0, r1 = bare.train(), armed.train()
+        assert r0["train_loss"] == r1["train_loss"]  # bit-identical
+        assert r0["lane_forensics"]["benign_mask"] == \
+            r1["lane_forensics"]["benign_mask"]
+        assert r0["lane_forensics"]["scores"] == \
+            r1["lane_forensics"]["scores"]
+        # Dense full participation: the cohort id-vector is the
+        # identity arange, so pre-cohort consumers read unchanged.
+        assert r1["lane_forensics"]["clients"] == list(range(N_CLIENTS))
+        assert len(r1["lane_forensics"]["update_norms"]) == N_CLIENTS
+        # Armed rows carry the schema-registered fleet fields.
+        for key in ("suspected_fraction", "flagged_churn",
+                    "reputation_p10", "reputation_p50", "reputation_p90",
+                    "ledger_clients_seen", "ledger_top_suspects"):
+            assert key in r1 and key not in r0
+        assert r1["ledger_clients_seen"] == N_CLIENTS
+        validate_record({"experiment": "e", "trial": "t",
+                         "training_iteration": rnd, **r1})
+
+    led = armed.client_ledger
+    assert bare.client_ledger is None
+    # Every client participated every round; flag counts reconcile
+    # with the per-row masks the same rows emitted.
+    part = np.asarray(led._column("participation"))
+    assert part.tolist() == [3] * N_CLIENTS
+    summary = armed.ledger_summary
+    assert summary["backend"] == "resident"
+    assert summary["clients_seen"] == N_CLIENTS
+    assert bare.ledger_summary is None
+
+
+# ---------------------------------------------------------------------------
+# cohort-shaped integration: windowed sampling and buffered-async cycles
+# ---------------------------------------------------------------------------
+
+
+def _reconcile_rows_against_ledger(rows, led, n_registered):
+    """Rebuild per-client lifetime tallies from the rows' cohort-shaped
+    lanes and demand the ledger agrees exactly."""
+    part = np.zeros(n_registered, np.int64)
+    flagged = np.zeros(n_registered, np.int64)
+    for row in rows:
+        lanes = row["lane_forensics"]
+        ids = lanes["clients"]
+        assert len(set(ids)) == len(ids)  # distinct within a round
+        assert all(0 <= c < n_registered for c in ids)
+        for c, ok in zip(ids, lanes["benign_mask"]):
+            part[c] += 1
+            flagged[c] += not ok
+    np.testing.assert_array_equal(
+        part, np.asarray(led._column("participation")))
+    np.testing.assert_array_equal(
+        flagged, np.asarray(led._column("flagged")))
+    return part
+
+
+def test_windowed_cohort_diagnosis_feeds_ledger(tmp_path):
+    """Participation-window rounds diagnose the SAMPLED cohort: lane i
+    maps to registered client clients[i], and the ledger's lifetime
+    tallies reconcile with the emitted lanes round for round."""
+    from blades_tpu.algorithms.config import FedavgConfig
+
+    w = 4
+    cfg = (FedavgConfig()
+           .data(dataset="mnist", num_clients=N, seed=3)
+           .training(global_model="mlp", server_lr=1.0,
+                     train_batch_size=8,
+                     aggregator={"type": "Median"})
+           .client(lr=0.1, momentum=0.9)
+           .evaluation(evaluation_interval=0)
+           .resources(state_store="host", window=w)
+           .observability(forensics=True,
+                          ledger="disk",
+                          ledger_dir=str(tmp_path / "led")))
+    algo = cfg.build()
+    rows = [algo.train() for _ in range(6)]
+    led = algo.client_ledger
+    assert led.backend == "disk"
+    for row in rows:
+        assert len(row["lane_forensics"]["clients"]) == w
+        assert row["ledger_clients_seen"] >= w
+    part = _reconcile_rows_against_ledger(rows, led, N)
+    assert part.sum() == 6 * w
+    # Cohorts rotate: more registered clients seen than one window.
+    assert (part > 0).sum() > w
+    algo.stop()
+    assert (tmp_path / "led").exists()  # caller-owned live dir survives
+
+
+def test_async_cycles_diagnose_events_and_feed_ledger():
+    """Buffered-async cycles diagnose the staleness-scaled event
+    matrix: lanes are the cycle's buffered arrivals (distinct clients
+    by take_cycle's contract), and the ledger folds the engine's
+    staleness column alongside the diagnosis."""
+    from blades_tpu.algorithms.config import FedavgConfig
+
+    agg_every = 4
+    cfg = (FedavgConfig()
+           .data(dataset="mnist", num_clients=N, seed=7)
+           .training(global_model="mlp",
+                     aggregator={"type": "Median"})
+           .resources(execution="async")
+           .arrivals(rate=0.4, agg_every=agg_every, staleness_cap=4)
+           .observability(forensics=True, ledger=True))
+    cfg.validate()
+    algo = cfg.build()
+    rows = [algo.train() for _ in range(4)]
+    led = algo.client_ledger
+    for row in rows:
+        lanes = row["lane_forensics"]
+        assert len(lanes["clients"]) == agg_every
+        assert "byz_precision" in row and "num_flagged" in row
+        assert row["tick"] >= 1
+        assert "suspected_fraction" in row
+    part = _reconcile_rows_against_ledger(rows, led, N)
+    assert part.sum() == 4 * agg_every
+    # The engine's per-event staleness column lands in the running
+    # stats: every participation folded exactly one staleness sample.
+    np.testing.assert_array_equal(
+        part, np.asarray(led._column("stale_count")))
+    seen = part > 0
+    stale_means = np.asarray(led._column("stale_mean"))[seen]
+    assert np.all(stale_means >= 0)
+    # Recency tracks the async clock, not the round counter.
+    ticks = np.asarray(led._column("last_tick"))[seen]
+    assert ticks.max() == max(row["tick"] for row in rows)
+
+
+# ---------------------------------------------------------------------------
+# scale: 100k registered clients on the disk backend, bounded host RAM
+# ---------------------------------------------------------------------------
+
+
+def test_100k_registered_disk_ledger_bounded_host_memory(tmp_path):
+    """Acceptance: a 100k-registered disk ledger observes cohorts,
+    computes fleet views, checkpoints and digests with host allocations
+    a small fraction of the population's column bytes (the memmaps are
+    page cache, not RSS)."""
+    n, cohort = 100_000, 512
+    rng = np.random.default_rng(0)
+    tracemalloc.start()
+    try:
+        led = make_ledger("disk", n, directory=str(tmp_path / "led"))
+        for rnd in range(1, 4):
+            ids = rng.choice(n, size=cohort, replace=False)
+            led.observe(np.sort(ids), round=rnd,
+                        flagged=rng.random(cohort) < 0.3,
+                        scores=rng.normal(size=cohort),
+                        norms=np.abs(rng.normal(size=cohort)))
+        rf = led.round_fields()
+        assert 0 < rf["ledger_clients_seen"] <= 3 * cohort
+        ck = tmp_path / "ckpt"
+        led.save(ck)
+        digest = led.digest()
+        _, peak = tracemalloc.get_traced_memory()
+    finally:
+        tracemalloc.stop()
+    assert led.host_bytes() == 0
+    assert led.total_bytes() == n * led.row_bytes
+    # Bounded host memory: far below the resident column footprint.
+    assert peak < led.total_bytes() // 4, (
+        f"peak {peak} bytes vs {led.total_bytes()} resident-equivalent")
+    num_shards = -(-n // DEFAULT_SHARD_ROWS)
+    num_ok, errors = validate_ledger_checkpoint(ck)
+    assert errors == [] and num_ok == num_shards * len(LEDGER_COLUMNS)
+    assert digest["n_registered"] == n
+    assert digest["clients_seen"] == rf["ledger_clients_seen"]
+    led.close()
+
+
+# ---------------------------------------------------------------------------
+# kill-and-resume: the ledger restores bit-identically mid-sweep
+# ---------------------------------------------------------------------------
+
+
+def _ledger_experiments(stop=8):
+    return {
+        "led": {
+            "run": "FEDAVG",
+            "stop": {"training_iteration": stop},
+            "config": {
+                "dataset_config": {"type": "mnist", "num_clients": N,
+                                   "train_bs": 8, "seed": 3},
+                "global_model": "mlp",
+                "client_config": {"lr": 0.1, "momentum": 0.9},
+                "evaluation_interval": 4,
+                "server_config": {"lr": 1.0,
+                                  "aggregator": {"type": "Median"}},
+                "state_store": "disk",
+                "state_window": 5,
+                "forensics": True,
+                "ledger": True,
+            },
+        }
+    }
+
+
+def _rows(tdir, keep_eval_rounds=(4, 8)):
+    rows = []
+    for ln in (Path(tdir) / "result.json").read_text().strip().splitlines():
+        r = json.loads(ln)
+        for k in ("timers", "compile_cache_hits", "compile_cache_misses",
+                  "state_stage_ms", "state_bytes_staged"):
+            r.pop(k, None)  # wall-clock / cache / staging-timing noise
+        if r["training_iteration"] not in keep_eval_rounds:
+            for k in ("test_loss", "test_acc", "test_acc_top3"):
+                r.pop(k, None)  # repeat-last-eval rows (not checkpointed)
+        rows.append(r)
+    return rows
+
+
+def test_kill_and_resume_ledger_bit_identical(tmp_path):
+    """Acceptance: a SimulatedPreemption mid-sweep restores the ledger
+    from its streaming shard checkpoint and reproduces the
+    straight-through rows — INCLUDING the longitudinal fleet fields
+    (suspected_fraction, flagged_churn, reputation percentiles) and the
+    end-of-trial summary["ledger"] block — bit for bit."""
+    from blades_tpu.tune import run_experiments
+
+    [straight] = run_experiments(
+        _ledger_experiments(), storage_path=str(tmp_path / "a"),
+        verbose=0, lanes=False, checkpoint_freq=2)
+    [preempted] = run_experiments(
+        _ledger_experiments(), storage_path=str(tmp_path / "b"),
+        verbose=0, lanes=False, checkpoint_freq=2, max_failures=1,
+        preempt_after=5, retry_backoff_base=0.0)
+    assert "status" not in preempted and preempted["rounds"] == 8
+    tdir = Path(preempted["dir"])
+    assert "SimulatedPreemption" in (tdir / "error.txt").read_text()
+
+    rows_a, rows_b = _rows(straight["dir"]), _rows(tdir)
+    assert len(rows_a) == len(rows_b) == 8
+    for ra, rb in zip(rows_a, rows_b):
+        assert ra == rb
+        for key in ("suspected_fraction", "flagged_churn",
+                    "reputation_p50", "ledger_clients_seen"):
+            assert key in ra
+    assert straight["ledger"] == preempted["ledger"]
+    assert straight["ledger"]["clients_seen"] >= 5
+    # The checkpoint the retry restored from carries the shard set.
+    manifests = sorted(tdir.glob("ckpt_*/ledger/manifest.json"))
+    assert manifests, "checkpoints must embed the ledger shard set"
+    num_ok, errors = validate_ledger_checkpoint(manifests[-1].parent)
+    assert errors == []
+
+
+# ---------------------------------------------------------------------------
+# fleet surfaces: watchdog rules, flight-recorder digests, CSV sink
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_reputation_collapse_and_flagger_churn():
+    from blades_tpu.obs.watchdog import Watchdog
+
+    wd = Watchdog()
+    names = {r.name for r in wd.rules}
+    assert {"reputation_collapse", "flagger_churn"} <= names
+
+    # Warm the rolling medians with healthy rounds.
+    steady = [{"training_iteration": i, "train_loss": 0.5,
+               "reputation_p50": 0.9, "flagged_churn": 2}
+              for i in range(1, 6)]
+    for row in steady:
+        assert wd.observe(row) == []
+    # Median reputation halves in one round: collapse fires.
+    events = wd.observe({"training_iteration": 6, "train_loss": 0.5,
+                         "reputation_p50": 0.4, "flagged_churn": 2})
+    assert [e.rule for e in events] == ["reputation_collapse"]
+    assert "reputation_p50" in events[0].message
+    # Churn spikes past 4x the rolling median: thrash alarm.
+    events = wd.observe({"training_iteration": 7, "train_loss": 0.5,
+                         "reputation_p50": 0.9, "flagged_churn": 9})
+    assert [e.rule for e in events] == ["flagger_churn"]
+
+    # Ledger off -> fields absent -> both rules inert.
+    wd2 = Watchdog()
+    for i in range(1, 10):
+        assert wd2.observe({"training_iteration": i,
+                            "train_loss": 0.5}) == []
+
+
+def test_watchdog_warm_replays_rows_with_ledger_fields():
+    """Kill-and-resume: warm() rebuilds the new rules' rolling windows
+    from on-disk rows WITHOUT re-firing events, and the warmed state
+    matches a straight-through observer's."""
+    from blades_tpu.obs.watchdog import Watchdog
+
+    rows = [{"training_iteration": i, "train_loss": 0.5,
+             "reputation_p50": 0.9, "flagged_churn": 2,
+             "watchdog_events": []}
+            for i in range(1, 6)]
+    rows[2]["watchdog_events"] = [
+        {"rule": "flagger_churn", "kind": "spike",
+         "field": "flagged_churn", "round": 3, "value": 9.0,
+         "limit": 8.0, "message": "churn spike"}]
+    warmed = Watchdog()
+    warmed.warm(rows)
+    # The durable event log came from the rows, not re-evaluation.
+    assert [e.rule for e in warmed.events] == ["flagger_churn"]
+    straight = Watchdog()
+    for row in rows:
+        straight.observe(row)
+    nxt = {"training_iteration": 6, "train_loss": 0.5,
+           "reputation_p50": 0.4, "flagged_churn": 2}
+    assert ([e.rule for e in warmed.observe(nxt)]
+            == [e.rule for e in straight.observe(nxt)]
+            == ["reputation_collapse"])
+
+
+def test_flightrec_dump_carries_ledger_digest(tmp_path):
+    from blades_tpu.obs.flightrec import FlightRecorder, validate_flightrec
+
+    fr = FlightRecorder(tmp_path / "flightrec.json", capacity=4,
+                        trial="t", algo="FEDAVG", config={"seed": 3})
+    fr.ledger = _fold_cohorts(make_ledger("resident", N))
+    for i in range(1, 4):
+        fr.record({"training_iteration": i, "train_loss": 0.5,
+                   "suspected_fraction": 0.25, "flagged_churn": 1})
+    fr.dump({"kind": "exception", "round": 3})
+    dump = json.loads((tmp_path / "flightrec.json").read_text())
+    assert dump["ledger"]["crc32"] == fr.ledger.digest()["crc32"]
+    assert dump["ledger"]["clients_seen"] == 4
+    # The digested rows keep the ledger's scalar fleet fields.
+    assert dump["rounds"][-1]["suspected_fraction"] == 0.25
+    _, errors = validate_flightrec(tmp_path / "flightrec.json")
+    assert errors == []
+
+    # A torn ledger must not lose the dump: the digest degrades to an
+    # error marker, the dump itself still lands.
+    class _Torn:
+        def digest(self):
+            raise LedgerError("torn mid-read")
+
+    fr.ledger = _Torn()
+    dump = fr.as_dump({"kind": "preemption"})
+    assert "LedgerError" in dump["ledger"]["error"]
+
+
+def test_csv_sink_skips_list_typed_ledger_field(tmp_path):
+    """The CSV header carries the scalar ledger fields and — by the
+    list-filter construction — never the list-typed suspects column."""
+    import csv
+
+    from blades_tpu.obs.metrics import _CSV_COLUMNS, CsvSink
+
+    assert "suspected_fraction" in _CSV_COLUMNS
+    assert "flagged_churn" in _CSV_COLUMNS
+    assert "reputation_p50" in _CSV_COLUMNS
+    assert "ledger_clients_seen" in _CSV_COLUMNS
+    assert "ledger_top_suspects" not in _CSV_COLUMNS
+    assert "watchdog_events" not in _CSV_COLUMNS
+
+    path = tmp_path / "progress.csv"
+    sink = CsvSink(path)
+    sink.emit({"trial": "t", "training_iteration": 1, "train_loss": 0.5,
+               "suspected_fraction": 0.25, "flagged_churn": 3,
+               "reputation_p50": 0.9, "ledger_clients_seen": 8,
+               "ledger_top_suspects": [2, 1, 0],
+               "watchdog_events": [{"rule": "flagger_churn"}]})
+    sink.close()
+    with open(path, newline="") as f:
+        header, row = list(csv.reader(f))
+    assert "ledger_top_suspects" not in header
+    got = dict(zip(header, row))
+    assert got["suspected_fraction"] == "0.25"
+    assert got["flagged_churn"] == "3"
+    assert got["ledger_clients_seen"] == "8"

@@ -133,6 +133,32 @@ def test_staging_discipline_fixtures():
     assert run_fixture([hs_good], "stagingdiscipline_good.py") == []
 
 
+def test_ledger_discipline_fixtures():
+    """ISSUE 16: the host-sync pass covers the client ledger's
+    per-round update path (blades_tpu/obs/ledger.py rides DEVICE_SIDE)
+    — observe() must consume already-fetched host rows; any device
+    fetch outside the pragma'd coercion boundary is a finding."""
+    from tools.lint.passes.host_sync import DEVICE_SIDE
+    from tools.lint.passes.purity import TRACED_MODULES
+
+    assert "blades_tpu/obs/ledger.py" in DEVICE_SIDE
+    # ...but NOT in jit-purity's whole-module set: the ledger is host
+    # code by construction and its checkpoint I/O is legitimate.
+    assert "blades_tpu/obs/ledger.py" not in TRACED_MODULES
+    hs = HostSyncPass(modules=[f"{FIX}/ledgerdiscipline_bad.py"])
+    bad = errors_of(run_fixture([hs], "ledgerdiscipline_bad.py"),
+                    "host-sync")
+    msgs = "\n".join(f.message for f in bad)
+    assert "np.asarray()" in msgs
+    assert "jax.device_get()" in msgs
+    assert "float() on an array expression" in msgs
+    assert "int() on an array expression" in msgs
+    assert ".block_until_ready()" in msgs
+    assert len(bad) == 5
+    hs_good = HostSyncPass(modules=[f"{FIX}/ledgerdiscipline_good.py"])
+    assert run_fixture([hs_good], "ledgerdiscipline_good.py") == []
+
+
 def test_static_args_fixtures():
     sa = StaticArgsPass(prefixes=[f"{FIX}/static_bad.py"])
     bad = errors_of(run_fixture([sa], "static_bad.py"), "static-config")

@@ -392,7 +392,7 @@ def test_forensics_off_training_is_bit_identical():
 def test_forensics_rejects_sharded_paths():
     cfg = _forensics_config("Median")
     cfg.resources(num_devices=8)
-    with pytest.raises(ValueError, match="single-chip"):
+    with pytest.raises(ValueError, match="unsupported pair"):
         cfg.validate()
     cfg2 = _forensics_config("Median")
     cfg2.update_from_dict({"execution": "streamed"})

@@ -437,7 +437,6 @@ def test_validate_gates():
     check("no windowed formulation", backend="host", window=4,
           execution="streamed")
     check("single-chip", backend="host", window=4, num_devices=2)
-    check("defense forensics", backend="host", window=4, forensics=True)
     check("fault injection", backend="host", window=4,
           fault_config={"dropout_rate": 0.3})
     check("rounds_per_dispatch", backend="host", window=4,
@@ -454,6 +453,9 @@ def test_validate_gates():
     windowed_config("disk", 4, health_check=True).validate()
     windowed_config("host", 4,
                     codec={"type": "quant", "bits": 8}).validate()
+    # Forensics composes since the cohort-shaped re-index (ISSUE 16):
+    # the windowed round diagnoses the (window, d) cohort matrix.
+    windowed_config("host", 4, forensics=True).validate()
 
 
 # ---------------------------------------------------------------------------

@@ -49,6 +49,13 @@ DEVICE_SIDE = (
     # pragmas; everything else is a finding.
     "blades_tpu/state/store.py",
     "blades_tpu/state/prefetch.py",
+    # Client-lifetime ledger (ISSUE 16): observe() runs once per round
+    # on the driver thread between dispatches — an unsanctioned device
+    # fetch there re-introduces exactly the per-round stall the
+    # deferred-row machinery removed.  The np.asarray coercions over
+    # ALREADY-FETCHED rows are the sanctioned boundary and carry
+    # per-line pragmas; any new sync is a finding.
+    "blades_tpu/obs/ledger.py",
     "blades_tpu/ops/aggregators.py",
     "blades_tpu/ops/clustering.py",
     "blades_tpu/ops/layout.py",

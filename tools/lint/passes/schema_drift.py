@@ -43,6 +43,9 @@ STAMP_MODULES = (
     "blades_tpu/tune/sweep.py",
     "blades_tpu/tune/lanes.py",
     "blades_tpu/comm/codecs.py",
+    # round_fields() builds the per-round ledger stamp (`rec` dict
+    # literal) that fedavg merges into the row verbatim.
+    "blades_tpu/obs/ledger.py",
 )
 _ROW_NAMES = {"row", "comm_row", "rec", "record", "_last_eval"}
 _DICT_RETURN_FNS = {"round_metrics"}

@@ -14,7 +14,10 @@ two artifact classes in ISSUE 12; this CLI is the one front door:
 - ``--flightrec``: ``flightrec.json`` dumps
   (:func:`blades_tpu.obs.flightrec.validate_flightrec`);
 - ``--trace``: Chrome/Perfetto span-trace exports
-  (:func:`blades_tpu.obs.trace.validate_chrome_trace`).
+  (:func:`blades_tpu.obs.trace.validate_chrome_trace`);
+- ``--ledger``: client-ledger checkpoint directories
+  (:func:`blades_tpu.obs.ledger.validate_ledger_checkpoint`) —
+  manifest CRCs against the shard files, layout drift, torn shards.
 
 Torn-write tolerance matches the metrics.jsonl contract everywhere: a
 torn final JSONL line (a killed writer) or an unreadable JSON artifact
@@ -28,6 +31,7 @@ Usage::
     python -m tools.validate_metrics <trial>/metrics.jsonl ...
     python -m tools.validate_metrics --flightrec <trial>/flightrec.json
     python -m tools.validate_metrics --trace traces/*.trace.json
+    python -m tools.validate_metrics --ledger <ckpt>/ledger
 """
 
 from __future__ import annotations
@@ -79,11 +83,22 @@ def _report(path, num_ok: int, what: str, errors) -> int:
             print(f"  line {lineno}: {msg}")
         else:
             print(f"  {err}")
-    tmp = Path(str(path) + ".tmp")
-    if tmp.exists():
-        print(f"  note: orphaned {tmp.name} alongside (an atomic write "
-              "was interrupted; the published file is the newest "
-              "complete artifact)")
+    p = Path(path)
+    if p.is_dir():
+        # Directory artifacts (ledger checkpoints): any *.tmp inside is
+        # an interrupted shard/manifest write the atomic-rename protocol
+        # never published — the named files are still complete.
+        orphans = sorted(t.name for t in p.glob("*.tmp"))
+        if orphans:
+            print(f"  note: orphaned {', '.join(orphans)} inside (atomic "
+                  "writes were interrupted; the published files are the "
+                  "newest complete artifacts)")
+    else:
+        tmp = Path(str(path) + ".tmp")
+        if tmp.exists():
+            print(f"  note: orphaned {tmp.name} alongside (an atomic write "
+                  "was interrupted; the published file is the newest "
+                  "complete artifact)")
     return 1 if errors else 0
 
 
@@ -99,6 +114,9 @@ def main(argv=None) -> int:
                       help="validate flightrec.json dump(s)")
     mode.add_argument("--trace", action="store_true",
                       help="validate Chrome/Perfetto trace export(s)")
+    mode.add_argument("--ledger", action="store_true",
+                      help="validate client-ledger checkpoint "
+                           "director(ies)")
     p.add_argument("paths", nargs="+")
     args = p.parse_args(argv)
 
@@ -118,6 +136,11 @@ def main(argv=None) -> int:
 
             num, errors = validate_chrome_trace(path)
             rc |= _report(path, num, "span event(s)", errors)
+        elif args.ledger:
+            from blades_tpu.obs.ledger import validate_ledger_checkpoint
+
+            num, errors = validate_ledger_checkpoint(path)
+            rc |= _report(path, num, "shard file(s)", errors)
         else:
             from blades_tpu.obs.schema import validate_jsonl
 
