@@ -1102,6 +1102,96 @@ def _ooc_block(cpu: bool) -> dict:
     return out
 
 
+def _measure_control_arm(controlled: bool, *, num_clients=32,
+                         num_byzantine=8, rounds=12, model="cnn",
+                         dataset="cifar10") -> dict:
+    """One arm of the BLADES_BENCH_CONTROL A/B (ISSUE 17): the
+    32-client protocol through the FULL driver under buffered-async
+    execution and a DiurnalALIE campaign attack (ALIE bursts scheduled
+    over virtual arrival time), with Signguard + forensics + the client
+    ledger armed in BOTH arms — the only delta is the closed-loop
+    controller quarantining ledger suspects vs the best static config
+    riding out the bursts.  Stamps the actions taken and the final
+    accuracy next to the wall time."""
+    from blades_tpu.algorithms import FedavgConfig
+
+    cfg = (
+        FedavgConfig()
+        .data(dataset=dataset, num_clients=num_clients, seed=7)
+        .training(global_model=model, server_lr=0.5,
+                  train_batch_size=BATCH,
+                  num_batch_per_round=LOCAL_STEPS,
+                  aggregator={"type": "Signguard"})
+        .client(lr=0.1)
+        .adversary(num_malicious_clients=num_byzantine,
+                   adversary_config={"type": "DiurnalALIE", "period": 8,
+                                     "duty": 0.99, "high": 1.5})
+        .evaluation(evaluation_interval=rounds)
+        .resources(execution="async")
+        .arrivals(rate=0.4, agg_every=8, staleness_cap=4, seed=7)
+        .observability(forensics=True, ledger=True, watchdog_rules=[
+            {"name": "suspect_ceiling", "kind": "ceiling",
+             "field": "suspected_fraction", "threshold": 0.05,
+             "min_points": 1}])
+    )
+    if controlled:
+        cfg.control(cooldown_rounds=2, quarantine_rounds=4,
+                    quarantine_max=4,
+                    rules={"suspect_ceiling": "quarantine"})
+    algo = cfg.build()
+    try:
+        row = algo.train()  # compile + settle outside the timed loop
+        t0 = time.perf_counter()
+        for _ in range(rounds - 1):
+            row = algo.train()
+        dt = time.perf_counter() - t0
+        final_loss = float(row["train_loss"])
+        assert final_loss == final_loss  # NaN guard
+        out = {
+            "rounds_per_sec": round((rounds - 1) / dt, 4),
+            "clients": num_clients, "byzantine": num_byzantine,
+            "model": model, "dataset": dataset, "batch": BATCH,
+            "local_steps": LOCAL_STEPS, "rounds": rounds,
+            "aggregator": "Signguard",
+            "adversary": "DiurnalALIE(period=8, duty=0.99)",
+            "path": "async_controlled" if controlled else "async_static",
+            "controlled": controlled,
+            "final_train_loss": round(final_loss, 5),
+        }
+        if row.get("test_acc") is not None:
+            out["final_test_acc"] = round(float(row["test_acc"]), 5)
+        if controlled:
+            out["actions_taken"] = row.get("control_actions_total")
+            out["final_quarantine_size"] = row.get("quarantine_size")
+            summary = getattr(algo, "control_summary", None)
+            if summary:
+                out["quarantined"] = summary.get("quarantined")
+                out["watchdog_events"] = summary.get("watchdog_events")
+        return out
+    finally:
+        algo.stop()
+
+
+def _control_block(cpu: bool) -> dict:
+    """BLADES_BENCH_CONTROL satellite (ISSUE 17): controlled vs
+    best-static A/B on the 32-client protocol under one campaign
+    attack.  The cpu arm runs the mnist/mlp reduction (full cifar10/cnn
+    async cycles blow the fallback box's budget); series are tagged by
+    model/dataset and compare only within themselves."""
+    kw = dict(model="mlp", dataset="mnist") if cpu else {}
+    static = _measure_control_arm(False, **kw)
+    controlled = _measure_control_arm(True, **kw)
+    out = {"static": static, "controlled": controlled}
+    if (static.get("final_test_acc") is not None
+            and controlled.get("final_test_acc") is not None):
+        out["acc_delta"] = round(
+            controlled["final_test_acc"] - static["final_test_acc"], 5)
+    if static["rounds_per_sec"]:
+        out["controlled_over_static"] = round(
+            controlled["rounds_per_sec"] / static["rounds_per_sec"], 3)
+    return out
+
+
 def _cpu_fallback(probe_err: str) -> None:
     """The relay-dead-box path: measure a REDUCED configuration of the
     same pipeline (FedAvg + ALIE forge + exact Median, dense round, CPU
@@ -1195,6 +1285,14 @@ def _cpu_fallback(probe_err: str) -> None:
             out["ooc"] = _ooc_block(cpu=True)
         except Exception as e:
             out["ooc"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+    if os.environ.get("BLADES_BENCH_CONTROL", "1") == "1":
+        try:
+            # Closed-loop control plane (ISSUE 17) on the reduced CPU
+            # config — controlled vs best-static under a DiurnalALIE
+            # campaign, actions taken + final-accuracy delta stamped.
+            out["control"] = _control_block(cpu=True)
+        except Exception as e:
+            out["control"] = {"error": f"{type(e).__name__}: {e}"[:300]}
     _emit(out)
 
 
@@ -1334,6 +1432,17 @@ def main() -> None:
             out["ooc"] = _ooc_block(cpu=False)
         except Exception as e:
             out["ooc"] = {"error": f"{type(e).__name__}: {e}"[:500]}
+
+    if os.environ.get("BLADES_BENCH_CONTROL", "1") == "1":
+        try:
+            # Closed-loop control plane (ISSUE 17): controlled vs
+            # best-static A/B on the 32-client async protocol under a
+            # DiurnalALIE campaign attack — the watchdog-driven
+            # quarantine loop vs a frozen config, actions taken and
+            # final-accuracy delta stamped next to the wall times.
+            out["control"] = _control_block(cpu=False)
+        except Exception as e:
+            out["control"] = {"error": f"{type(e).__name__}: {e}"[:500]}
 
     _emit(out)
 

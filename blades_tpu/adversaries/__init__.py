@@ -21,6 +21,10 @@ from blades_tpu.adversaries.base import (  # noqa: F401
     benign_mean_std,
     make_malicious_mask,
 )
+from blades_tpu.adversaries.campaigns import (  # noqa: F401
+    DiurnalALIECampaign,
+    LazyRampCampaign,
+)
 from blades_tpu.adversaries.training_attacks import (  # noqa: F401
     LabelFlipAdversary,
     SignFlipAdversary,
@@ -50,6 +54,13 @@ ADVERSARIES = {
     # updates — the adversary class the async arrival model exists to
     # express (blades_tpu/arrivals).
     "Lazy": LazyAdversary,
+    # Campaign adversaries (adversaries/campaigns.py): attacks adapting
+    # over VIRTUAL time (diurnal ALIE bursts, ramping free-riders) —
+    # the moving-target regime the closed-loop controller
+    # (blades_tpu/control) defends; async-only (they schedule against
+    # the arrival tick clock).
+    "DiurnalALIE": DiurnalALIECampaign,
+    "LazyRamp": LazyRampCampaign,
 }
 
 _ALIASES = {cls.__name__: cls for cls in ADVERSARIES.values()}

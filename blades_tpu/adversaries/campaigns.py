@@ -1,0 +1,192 @@
+"""Campaign adversaries: attacks that adapt over VIRTUAL time.
+
+The static attacks in :mod:`blades_tpu.adversaries.update_attacks` forge
+the same way every round — exactly the regime a frozen defense config is
+tuned for.  Campaigns are the moving-target case the closed-loop
+controller (:mod:`blades_tpu.control`) exists for: attack strength and
+attacker population change on a schedule over the async engine's virtual
+tick clock, so a config that was right at tick 0 is wrong by mid-day.
+
+Time discipline: campaigns read the PER-EVENT arrival ticks the cycle
+already carries (``ev_ticks``) — virtual time, never wall clock — via
+the ``wants_ticks`` contract (:mod:`blades_tpu.arrivals.cycle` passes
+``ticks=`` iff the adversary declares it, mirroring the
+``wants_stale_replay`` contract).  Each malicious lane decides from its
+OWN arrival tick, so a cycle straddling a schedule boundary forges each
+event against the regime it arrived under — pure in (event, tick), hence
+bit-replayable.
+
+Campaigns declare ``requires_virtual_time`` and config.validate() pins
+them to ``execution='async'``: a synchronous round has no tick to
+schedule against.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from statistics import NormalDist
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from blades_tpu.adversaries.base import Adversary, benign_mean_std
+from blades_tpu.adversaries.update_attacks import _negate_first_half
+from blades_tpu.ops.aggregators import Signguard
+
+
+def _normalize_schedule(schedule) -> Tuple[Tuple[int, float], ...]:
+    """Validate a piecewise-constant ``((tick, value), ...)`` schedule:
+    absolute ticks, strictly increasing, starting at 0 (the arrival
+    ``rate_schedule`` discipline — campaigns are designed to ride the
+    same breakpoints)."""
+    out = tuple((int(t), float(v)) for t, v in schedule)
+    if not out:
+        raise ValueError("campaign schedule must be non-empty")
+    if out[0][0] != 0:
+        raise ValueError(
+            f"campaign schedule must start at tick 0, got {out[0][0]} "
+            "(absolute virtual ticks, like arrivals' rate_schedule)")
+    ticks = [t for t, _ in out]
+    if any(b <= a for a, b in zip(ticks, ticks[1:])):
+        raise ValueError(
+            f"campaign schedule ticks must be strictly increasing, got "
+            f"{ticks}")
+    return out
+
+
+def _schedule_at(schedule: Tuple[Tuple[int, float], ...], ticks):
+    """Traced piecewise-constant lookup (the ``rate_at`` idiom):
+    segment i covers [tick_i, tick_{i+1})."""
+    bounds = jnp.asarray([t for t, _ in schedule[1:]], dtype=jnp.int32)
+    values = jnp.asarray([v for _, v in schedule], dtype=jnp.float32)
+    return values[jnp.searchsorted(bounds, ticks, side="right")]
+
+
+@dataclasses.dataclass(frozen=True)
+class DiurnalALIECampaign(Adversary):
+    """ALIE with diurnally scheduled strength (registered ``DiurnalALIE``).
+
+    A square wave over virtual time: for ``duty * period`` ticks of every
+    ``period``-tick day the forged deviation runs at ``high`` x the ALIE
+    ``z_max``; off-peak it drops to ``low`` x (``low=0`` ships the benign
+    mean — geometrically invisible, letting reputations and detection
+    recall recover before the next burst).  This is the
+    detection-recall-scheduled attacker: each burst re-poisons faster
+    than a static config re-flags, while the off-peak lull starves
+    rolling-window defenses of evidence.  SignGuard-aware like the
+    static ALIE (negated first half of the deviation).
+    """
+
+    num_clients: int = 60
+    num_byzantine: int = 0
+    period: int = 64
+    duty: float = 0.5
+    low: float = 0.0
+    high: float = 1.0
+    phase: int = 0
+
+    def __post_init__(self):
+        if self.period < 2:
+            raise ValueError("DiurnalALIE period must be >= 2 ticks")
+        if not (0.0 < self.duty < 1.0):
+            raise ValueError("DiurnalALIE duty must be in (0, 1)")
+
+    @property
+    def wants_ticks(self) -> bool:
+        """Async-cycle contract: pass per-event arrival ticks."""
+        return True
+
+    @property
+    def requires_virtual_time(self) -> bool:
+        return True
+
+    @property
+    def z_max(self) -> float:
+        n, f = self.num_clients, self.num_byzantine
+        s = n // 2 + 1 - f
+        cdf = (n - f - s) / max(n - f, 1)
+        return NormalDist().inv_cdf(min(max(cdf, 1e-9), 1.0 - 1e-9))
+
+    def on_updates_ready(self, updates, malicious, key, *, aggregator=None,
+                         global_params=None, shard=None, ticks=None):
+        del key, global_params
+        mean, std = benign_mean_std(updates, malicious)
+        if isinstance(aggregator, Signguard):
+            std = _negate_first_half(std, shard)
+        if ticks is None:
+            ticks = jnp.zeros((updates.shape[0],), dtype=jnp.int32)
+        in_peak = jnp.mod(ticks + self.phase, self.period) \
+            < int(self.duty * self.period)
+        mult = jnp.where(in_peak, self.high, self.low).astype(updates.dtype)
+        forged = mean[None, :] + (mult * self.z_max)[:, None] * std[None, :]
+        return jnp.where(malicious[:, None], forged, updates)
+
+
+@dataclasses.dataclass(frozen=True)
+class LazyRampCampaign(Adversary):
+    """Lazy free-riders activating on a ramp schedule (registered
+    ``LazyRamp``).
+
+    ``ramp`` is a piecewise-constant ``((tick, fraction), ...)`` giving
+    the ACTIVE fraction of the malicious population over virtual time —
+    set its breakpoints to the arrival ``rate_schedule``'s and the
+    attack fraction rides the traffic curve (free-riders surfacing
+    exactly when the controller is busy relaxing cutoffs to absorb an
+    ingest surge).  Malicious lanes are a prefix (make_malicious_mask),
+    so lane ``i`` activates iff its prefix rank < ``floor(fraction * f)``
+    at its OWN arrival tick; inactive lanes ship their honest work
+    untouched — a free-rider that has not started freeloading yet is an
+    ordinary client, which is what makes the ramp hard to pre-flag.
+
+    Active lanes plagiarize the benign mean (BLADE-FL's lazy miner,
+    arXiv:2012.02044) scaled by ``copy_scale`` plus keyed Gaussian
+    camouflage noise (``noise_std``) — benign geometry, so row-norm
+    defenses pass it and only reputation/staleness pressure catches it.
+    """
+
+    num_clients: int = 60
+    num_byzantine: int = 0
+    ramp: Tuple[Tuple[int, float], ...] = ((0, 0.0),)
+    copy_scale: float = 1.0
+    noise_std: float = 1e-3
+
+    def __post_init__(self):
+        ramp = _normalize_schedule(self.ramp)
+        for t, frac in ramp:
+            if not (0.0 <= frac <= 1.0):
+                raise ValueError(
+                    f"LazyRamp fraction at tick {t} must be in [0, 1], "
+                    f"got {frac}")
+        object.__setattr__(self, "ramp", ramp)
+
+    @property
+    def wants_ticks(self) -> bool:
+        return True
+
+    @property
+    def requires_virtual_time(self) -> bool:
+        return True
+
+    def on_updates_ready(self, updates, malicious, key, *, aggregator=None,
+                         global_params=None, shard=None, ticks=None):
+        del aggregator, global_params
+        if ticks is None:
+            ticks = jnp.zeros((updates.shape[0],), dtype=jnp.int32)
+        frac = _schedule_at(self.ramp, ticks)
+        active_count = jnp.floor(
+            frac * float(self.num_byzantine) + 1e-6).astype(jnp.int32)
+        rank = jnp.cumsum(malicious.astype(jnp.int32)) - 1
+        active = malicious & (rank < active_count)
+        if shard is not None:
+            # The NoiseAdversary discipline: fold the shard index so the
+            # camouflage draw is i.i.d. across the full row, and zero
+            # the padding columns so psum'd row geometry stays exact.
+            key = shard.fold(key)
+        noise = self.noise_std * jax.random.normal(
+            key, updates.shape, updates.dtype)
+        if shard is not None:
+            noise = jnp.where(shard.valid()[None, :], noise, 0.0)
+        mean, _ = benign_mean_std(updates, malicious)
+        forged = self.copy_scale * mean[None, :] + noise
+        return jnp.where(active[:, None], forged, updates)

@@ -232,6 +232,22 @@ class FedavgConfig:
         # Directory for the disk ledger's live memmap columns (None = a
         # private temp dir, removed when the trial stops).
         self.ledger_dir: Optional[str] = None
+        # Watchdog rule overrides (obs/watchdog.py): a list of rule
+        # dicts ({"name", "kind", "field", + window/min_points/factor/
+        # threshold}) REPLACING the built-in table — the
+        # ``--watchdog-rules`` CLI surface.  Unknown keys, unknown
+        # kinds and unknown fields fail at validate().  None keeps
+        # ``default_rules()``.
+        self.watchdog_rules: Optional[list] = None
+        # Closed-loop control plane (blades_tpu/control): watchdog
+        # events drive bounded, journaled actuator moves (shrink
+        # agg_every, grow the arrival buffer / relax the staleness
+        # cutoff, quarantine-and-probe ledger suspects, re-run the
+        # autotuner).  A dict of ControlPolicy knobs + {"enabled":
+        # bool, "rules": {rule-name: actuator-family | "off"}}; set via
+        # .control(...).  None disables — rounds are then bit-identical
+        # to an uncontrolled build.
+        self.control_config: Optional[Dict] = None
         # server root-dataset size for trust-bootstrapped aggregators (FLTrust)
         self.fltrust_root_size: int = 100
         # resources
@@ -374,14 +390,52 @@ class FedavgConfig:
                 spec[k] = v
         return self._set(async_config=spec or None)
 
-    def observability(self, *, forensics=None, ledger=None, ledger_dir=None):
+    def observability(self, *, forensics=None, ledger=None, ledger_dir=None,
+                      watchdog_rules=None):
         """Defense forensics (per-lane aggregator diagnostics + Byzantine
-        detection precision/recall/FPR per round) and the client-lifetime
+        detection precision/recall/FPR per round), the client-lifetime
         ledger (``ledger=True`` for the resident backend, ``"disk"`` to
         memmap the columns; ``ledger_dir=`` the disk backend's live
-        directory) — the obs subsystem."""
+        directory) and the watchdog rule table (``watchdog_rules=`` a
+        list of rule dicts replacing ``default_rules()``; the
+        ``--watchdog-rules`` CLI flag routes here) — the obs
+        subsystem."""
         return self._set(forensics=forensics, ledger=ledger,
-                         ledger_dir=ledger_dir)
+                         ledger_dir=ledger_dir,
+                         watchdog_rules=watchdog_rules)
+
+    def control(self, *, enabled=None, rules=None, cooldown_rounds=None,
+                quarantine_rounds=None, quarantine_max=None,
+                max_quarantine_fraction=None, min_agg_every=None,
+                agg_every_factor=None, buffer_factor=None,
+                max_buffer_capacity=None, cutoff_factor=None,
+                max_weight_cutoff=None):
+        """Closed-loop control plane (:mod:`blades_tpu.control`):
+        watchdog events drive bounded, rate-limited, journaled actuator
+        moves.  ``rules=`` maps watchdog rule NAMES to actuator families
+        (``agg_every`` | ``buffer`` | ``quarantine`` | ``replan`` |
+        ``"off"``), merged over the default table; the remaining knobs
+        are :class:`~blades_tpu.control.ControlPolicy` bounds and rate
+        limits.  Merges into ``control_config`` (the ``.arrivals()``
+        pattern); a bare ``.control()`` arms the defaults.  See the
+        README "Control plane" section."""
+        spec = dict(self.control_config or {})
+        for k, v in (("enabled", enabled), ("rules", rules),
+                     ("cooldown_rounds", cooldown_rounds),
+                     ("quarantine_rounds", quarantine_rounds),
+                     ("quarantine_max", quarantine_max),
+                     ("max_quarantine_fraction", max_quarantine_fraction),
+                     ("min_agg_every", min_agg_every),
+                     ("agg_every_factor", agg_every_factor),
+                     ("buffer_factor", buffer_factor),
+                     ("max_buffer_capacity", max_buffer_capacity),
+                     ("cutoff_factor", cutoff_factor),
+                     ("max_weight_cutoff", max_weight_cutoff)):
+            if v is not None:
+                spec[k] = v
+        if not spec:
+            spec = {"enabled": True}  # bare .control() arms the defaults
+        return self._set(control_config=spec)
 
     def communication(self, *, codec=None, agg_domain=None):
         """Compressed-update codec on the client->server uplink
@@ -781,6 +835,98 @@ class FedavgConfig:
                 ".observability(ledger='disk') (ledger_dir names the "
                 "disk backend's live directory) or drop ledger_dir"
             )
+        # Watchdog rule overrides: build the table now so an unknown
+        # key / kind / field fails at validate() time — the
+        # faults/codecs fail-fast discipline.
+        if self.watchdog_rules is not None:
+            self.get_watchdog_rules()
+        # Campaign adversaries (adversaries/campaigns.py) schedule their
+        # attack over VIRTUAL TIME — only the async engine has a tick
+        # clock to ride.
+        if self.adversary_config:
+            adv = self.get_adversary()
+            if getattr(adv, "requires_virtual_time", False) \
+                    and self.execution != "async":
+                raise ValueError(
+                    f"adversary {self.adversary_config.get('type')!r} is a "
+                    "campaign attack scheduled over virtual arrival time; "
+                    f"execution={self.execution!r} has no tick clock — set "
+                    ".resources(execution='async')"
+                )
+        # Closed-loop control plane: build the policy now (unknown keys
+        # / bad bounds fail here), then gate the structurally impossible
+        # pairs with the exact knob that flips each one.
+        policy = self.get_control_policy()
+        if policy is not None:
+            if int(self.rounds_per_dispatch or 1) != 1:
+                raise ValueError(
+                    "control × rounds_per_dispatch > 1 is an unsupported "
+                    "pair: the controller observes and actuates between "
+                    "HOST-VISIBLE rounds, and a fused dispatch gives it "
+                    "none — set rounds_per_dispatch=1, or drop .control()"
+                )
+            if self.execution in ("streamed", "dsharded"):
+                raise ValueError(
+                    f"control × execution={self.execution!r} is an "
+                    "unsupported pair: the controller's sensors ride "
+                    "forensics/ledger row fields those paths never "
+                    "produce — use execution='dense'/'async', or drop "
+                    ".control()"
+                )
+            if self.num_devices and self.num_devices > 1:
+                raise ValueError(
+                    "control × num_devices>1 is an unsupported pair "
+                    "(same lane-axis constraint as forensics/ledger) — "
+                    "set .resources(num_devices=None), or drop .control()"
+                )
+            quarantine_armed = policy.quarantine_rounds > 0 and any(
+                fam == "quarantine" for _, fam in policy.rule_table)
+            if quarantine_armed:
+                # Quarantine moves mask clients at the async ingest
+                # filter and pick targets from the ledger's reputation
+                # ranking over forensics diagnoses — all three are load-
+                # bearing.
+                for missing, why, flip in (
+                    (self.execution != "async",
+                     "an async ingest path to mask clients at",
+                     ".resources(execution='async')"),
+                    (not self.forensics,
+                     "per-lane diagnoses to probe against",
+                     ".observability(forensics=True)"),
+                    (not self.ledger_backend,
+                     "the ledger's reputation ranking to pick suspects",
+                     ".observability(ledger=True)"),
+                ):
+                    if missing:
+                        raise ValueError(
+                            "control quarantine moves need " + why +
+                            f" — set {flip}, or disable them with "
+                            ".control(quarantine_rounds=0) or "
+                            ".control(rules={'fpr_collapse': 'off', "
+                            "'reputation_collapse': 'off'})"
+                        )
+                spec = self.get_async_spec()
+                ceiling = int(policy.max_quarantine_fraction
+                              * self.num_clients)
+                if self.num_clients - ceiling < spec.agg_every:
+                    raise ValueError(
+                        f"control max_quarantine_fraction="
+                        f"{policy.max_quarantine_fraction} could "
+                        f"quarantine {ceiling} of {self.num_clients} "
+                        f"clients, starving agg_every={spec.agg_every} "
+                        "(a cycle buffers at most one event per free "
+                        "client) — lower the fraction or agg_every"
+                    )
+            if self.execution == "async" and self.state_store != "resident" \
+                    and any(fam in ("agg_every", "buffer")
+                            for _, fam in policy.rule_table):
+                raise ValueError(
+                    f"control agg_every/buffer moves × state_store="
+                    f"{self.state_store!r} is an unsupported pair: the "
+                    "out-of-core store sizes its staging rows by the "
+                    "initial agg_every — set state_store='resident', or "
+                    "map those rules 'off' in .control(rules=...)"
+                )
         if self.client_packing not in ("off", "auto", None):
             # Forced int P: structural impossibilities fail at validate()
             # time, the same fail-fast discipline as faults/codecs.  The
@@ -971,6 +1117,39 @@ class FedavgConfig:
             spec["rate_schedule"] = tuple(
                 tuple(p) for p in spec["rate_schedule"])
         return AsyncSpec(**spec)
+
+    @property
+    def control_enabled(self) -> bool:
+        """Whether the closed-loop control plane is armed: a
+        ``control_config`` dict whose ``enabled`` (default True when the
+        dict exists) is truthy."""
+        cfg = self.control_config
+        if cfg is None:
+            return False
+        if not isinstance(cfg, dict):
+            raise ValueError(
+                f"control_config must be a dict, got {type(cfg).__name__}")
+        return bool(cfg.get("enabled", True))
+
+    def get_watchdog_rules(self) -> tuple:
+        """The watchdog rule table the trial runs under:
+        ``watchdog_rules`` overrides resolved through
+        :func:`blades_tpu.obs.watchdog.rules_from_config` (which
+        fail-fasts on unknown keys/kinds/fields), or the built-in
+        ``default_rules()``."""
+        from blades_tpu.obs.watchdog import rules_from_config
+
+        return rules_from_config(self.watchdog_rules)
+
+    def get_control_policy(self):
+        """Build the control plane's
+        :class:`~blades_tpu.control.ControlPolicy` from
+        ``control_config`` (None when disarmed)."""
+        if not self.control_enabled:
+            return None
+        from blades_tpu.control import ControlPolicy
+
+        return ControlPolicy.from_config(self.control_config)
 
     def get_codec(self):
         """Build the comm subsystem's

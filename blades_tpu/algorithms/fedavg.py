@@ -310,6 +310,38 @@ class Fedavg:
                 cfg.ledger_backend, cfg.num_clients,
                 directory=getattr(cfg, "ledger_dir", None))
 
+        # Closed-loop control plane (blades_tpu/control): the driver
+        # owns its OWN watchdog over finalized rows (stamping
+        # watchdog_events itself; the sweep's post-hoc watchdog defers
+        # to rows already stamped) and applies the controller's
+        # journaled actions back to the engine after every round.
+        self._controller = None
+        self._watchdog = None
+        if getattr(cfg, "control_enabled", False):
+            from blades_tpu.control import Controller
+            from blades_tpu.obs.watchdog import Watchdog
+
+            self._watchdog = Watchdog(cfg.get_watchdog_rules())
+            if self._async is not None:
+                self._controller = Controller(
+                    cfg.get_control_policy(), num_clients=cfg.num_clients,
+                    agg_every=int(self._async.agg_every),
+                    buffer_capacity=int(self._async.buffer.capacity),
+                    weight_cutoff=int(self._async.weight_cutoff),
+                    allow_replan=False,  # async × autotune is forbidden
+                )
+            else:
+                # Sync driver: none of the three async actuators exist;
+                # a replan is the one live response (dense/windowed
+                # single-chip only — the windowed store/prefetcher must
+                # not be rebuilt mid-run).
+                self._controller = Controller(
+                    cfg.get_control_policy(), num_clients=cfg.num_clients,
+                    allow_replan=bool(getattr(cfg, "autotune_mode", None)
+                                      and self._state_pf is None
+                                      and self.mesh is None),
+                )
+
         self.timers = Timers()
         self._iteration = 0
         self._rounds_since_eval = 0
@@ -974,7 +1006,7 @@ class Fedavg:
             "backend": self._state_store.backend,
             "window": (int(self.config.state_window)
                        if self._state_pf is not None
-                       else int(self._async.spec.agg_every)),
+                       else int(self._async.agg_every)),
             "n_registered": self._state_store.n_registered,
             "row_bytes": int(self._state_store.row_bytes),
             "total_bytes": int(self._state_store.total_bytes()),
@@ -996,6 +1028,18 @@ class Fedavg:
         if self._ledger is None:
             return None
         return self._ledger.summary()
+
+    @property
+    def control_summary(self) -> Optional[Dict]:
+        """Closed-loop controller digest for sweep summaries (actions
+        journaled, live actuator view, quarantine/probation sets,
+        driver-watchdog event count), or ``None`` when control is
+        off."""
+        if self._controller is None:
+            return None
+        out = self._controller.summary()
+        out["watchdog_events"] = len(self._watchdog.events)
+        return out
 
     @property
     def packing_summary(self) -> Optional[Dict]:
@@ -1112,6 +1156,12 @@ class Fedavg:
             row["buffer_overflow"] = int(info["buffer_overflow"])
             row["arrival_seed"] = int(info["arrival_seed"])
             row["updates_per_sec"] = round(info["events"] / elapsed, 3)
+            # Control-plane sensors (blades_tpu/control): virtual ticks
+            # this cycle spent ingesting (the deterministic twin of
+            # updates_per_sec) and the cumulative quarantine-filtered
+            # arrival count — host ints, replay-comparable.
+            row["cycle_ticks"] = int(info["cycle_ticks"])
+            row["arrivals_quarantined"] = int(info["arrivals_quarantined"])
             # Event cohort: lane i of this cycle's diag/metrics lanes is
             # registered client last_clients[i].  Captured NOW so a
             # deferred row keeps its own cohort after later cycles
@@ -1137,7 +1187,7 @@ class Fedavg:
             row["state_store"] = self._state_store.backend
             row["cohort_size"] = (int(self.config.state_window)
                                   if self._state_pf is not None
-                                  else int(self._async.spec.agg_every))
+                                  else int(self._async.agg_every))
             row["state_stage_ms"] = round(stats.last_stage_ms, 3)
             row["state_bytes_staged"] = int(stats.last_bytes_staged)
             row["state_peak_hbm_bytes"] = int(stats.peak_hbm_bytes)
@@ -1320,6 +1370,95 @@ class Fedavg:
                 tick=row.get("tick"), flagged=flagged, scores=scores,
                 staleness=cohort_staleness, norms=norms)
             row.update(self._ledger.round_fields())
+        if self._controller is not None:
+            # Closed-loop control (blades_tpu/control): runs LAST so the
+            # watchdog and policy see the fully-stamped row (ledger
+            # fleet fields included).
+            self._control_round(row, lanes, cohort_ids)
+
+    def _control_round(self, row: Dict, lanes: Dict, cohort_ids) -> None:
+        """One control step over the finalized row: observe the driver's
+        watchdog, stamp ``watchdog_events``, let the controller journal
+        its policy decisions, apply them to the engine, and stamp the
+        action fields the flight recorder replays bit-for-bit."""
+        events = [e.as_dict() for e in self._watchdog.observe(row)]
+        row["watchdog_events"] = events
+        participants: tuple = ()
+        flagged_ids: tuple = ()
+        if cohort_ids is not None and "benign_mask" in lanes:
+            ids = np.asarray(cohort_ids, np.int64)
+            bad = np.asarray(lanes["benign_mask"]) <= 0.5
+            participants = tuple(int(c) for c in ids)
+            flagged_ids = tuple(int(c) for c in ids[bad])
+        actions = self._controller.step(
+            round_idx=int(row["training_iteration"]),
+            tick=int(row.get("tick", row["training_iteration"])),
+            events=events,
+            suspects=row.get("ledger_top_suspects") or (),
+            participants=participants, flagged=flagged_ids)
+        for act in actions:
+            self._apply_control_action(act)
+        row["control_actions"] = [a.as_dict() for a in actions]
+        row["control_actions_total"] = int(self._controller.actions_total)
+        row["quarantine_size"] = len(self._controller.quarantine)
+
+    def _apply_control_action(self, act) -> None:
+        """Actuate one journaled decision.  A rejected engine move is a
+        LOUD warning, never a crash — the journal records the intent
+        either way, and view/engine divergence must be visible."""
+        eng = self._async
+        try:
+            if act.actuator == "agg_every" and eng is not None:
+                eng.set_agg_every(int(act.new))
+            elif act.actuator == "buffer_capacity" and eng is not None:
+                eng.set_buffer_capacity(int(act.new))
+            elif act.actuator == "weight_cutoff" and eng is not None:
+                eng.set_weight_cutoff(int(act.new))
+            elif act.actuator in ("quarantine", "probe", "readmit",
+                                  "requarantine"):
+                if eng is not None:
+                    eng.set_quarantine(
+                        self._controller.quarantined_clients())
+            elif act.actuator == "replan":
+                self._replan_runtime()
+        except ValueError as exc:
+            warnings.warn(
+                f"control action {act.actuator} (seq {act.seq}) was "
+                f"journaled but the engine rejected it: {exc}",
+                RuntimeWarning, stacklevel=2)
+
+    def _replan_runtime(self) -> None:
+        """Re-run the execution autotuner against current geometry and
+        rebuild the round pipeline when the winner changed (sync
+        dense path only — async × autotune is a forbidden config pair,
+        and the windowed store must not be rebuilt mid-run)."""
+        cfg = self.config
+        if (not getattr(cfg, "autotune_mode", None) or self._async is not None
+                or self._state_pf is not None or self.mesh is not None):
+            return
+        from blades_tpu.perf import autotune as at
+
+        mode = cfg.autotune_mode
+        space = self._plan_space(
+            allow_reassociating=(mode == "reassociating"))
+        measure = (at.timed_measure_fn(cfg) if at.timing_available()
+                   else None)
+        plan, prov = at.select_plan(space, measure_fn=measure)
+        prov["mode"] = "replan"
+        self._plan_provenance = prov
+        if self._plan is not None and plan.as_dict() == self._plan.as_dict():
+            return  # the standing plan won again — nothing to rebuild
+        self._plan = plan
+        self._apply_plan(plan)
+        if self._use_streamed():
+            # A replan is only offered within the dense plan space (the
+            # controller gate above); a streamed resolution here would
+            # mean the space drifted — refuse rather than rebuild wrong.
+            warnings.warn("replan resolved a streamed plan mid-run; "
+                          "keeping the standing pipeline",
+                          RuntimeWarning, stacklevel=2)
+            return
+        self._setup_dense_pipeline()
 
     def train_rows(self, per_round: bool = False) -> List[Dict]:
         """One training dispatch, returned as result ROWS.
@@ -1465,6 +1604,14 @@ class Fedavg:
             # buffered trajectory bit-identically.
             "arrivals": (self._async.host_state()
                          if self._async is not None else None),
+            # Closed-loop control state (blades_tpu/control): watchdog
+            # rolling windows + controller journal/cooldowns/quarantine
+            # — with these a kill-and-resume continues the EXACT action
+            # journal a straight-through run would produce (the engine's
+            # live actuator values ride the arrivals payload above).
+            "control": ({"watchdog": self._watchdog.state(),
+                         "controller": self._controller.state()}
+                        if self._controller is not None else None),
             "config_dict": {k: v for k, v in self.config.items()
                             if not callable(v)},
         }
@@ -1649,6 +1796,36 @@ class Fedavg:
                 state = _dc.replace(
                     state,
                     arrivals=self._async.init_history(state.server.params))
+        if self._controller is not None:
+            ctl = payload.get("control")
+            if ctl:
+                self._watchdog.restore_state(ctl.get("watchdog") or {})
+                self._controller.restore(ctl.get("controller") or {})
+                if self._async is not None:
+                    # The engine's live actuator values rode the
+                    # arrivals payload; re-assert from the controller's
+                    # view only where an older payload left defaults.
+                    v = self._controller.values
+                    if (v.get("agg_every")
+                            and int(v["agg_every"]) != self._async.agg_every):
+                        self._async.set_agg_every(int(v["agg_every"]))
+                    if (v.get("weight_cutoff") is not None
+                            and int(v["weight_cutoff"])
+                            != self._async.weight_cutoff):
+                        self._async.set_weight_cutoff(
+                            int(v["weight_cutoff"]))
+                    held = self._controller.quarantined_clients()
+                    if held != self._async.quarantine:
+                        self._async.set_quarantine(held)
+            else:
+                # Checkpoint from an uncontrolled run resumed under
+                # control: the controller starts cold at the restored
+                # round — the journal before it is unrecoverable.
+                warnings.warn(
+                    "checkpoint carries no control payload; the "
+                    "controller starts cold at round "
+                    f"{self._iteration} (the action journal before it "
+                    "is not recoverable)", RuntimeWarning, stacklevel=2)
         if self.mesh is not None:
             from blades_tpu.parallel import shard_federation
 

@@ -104,6 +104,11 @@ def build_cycle(fed_round, *, staleness_cap: int, weight_schedule: str,
     # maximally stale work while claiming freshness (the attack only an
     # async server can express; see adversaries.LazyAdversary).
     stale_replay = bool(getattr(adv, "wants_stale_replay", False))
+    # Campaign adversaries (adversaries/campaigns.py): attacks that
+    # adapt over virtual time declare `wants_ticks` and receive the
+    # per-event arrival ticks — the same deterministic columns the
+    # engine already built, so scheduled attacks replay bit-identically.
+    wants_ticks = bool(getattr(adv, "wants_ticks", False))
     fill_value = None
     if corrupt_mode is not None:
         from blades_tpu.faults.injector import _CORRUPT_FILL
@@ -170,11 +175,15 @@ def build_cycle(fed_round, *, staleness_cap: int, weight_schedule: str,
                     updates)
         if adv is not None and hasattr(adv, "on_updates_ready"):
             k_adv = jax.random.fold_in(k_agg, 2)
+            forge_kwargs = {}
+            if wants_ticks:
+                forge_kwargs["ticks"] = ev_ticks
             with jax.named_scope("blades/forge"):
                 updates = adv.on_updates_ready(
                     updates, ev_malicious, k_adv,
                     aggregator=fed_round.server.aggregator,
                     global_params=state.server.params,
+                    **forge_kwargs,
                 )
         trusted_update = fed_round.compute_trusted_update(
             state.server.params, jax.random.fold_in(k_agg, 1))

@@ -159,15 +159,17 @@ class AsyncEngine:
         corrupt_mode = (fault_injector.corrupt_mode
                         if fault_injector is not None
                         and fault_injector.corrupt_rate > 0.0 else None)
-        self._cycle = jax.jit(build_cycle(
-            fed_round, staleness_cap=spec.staleness_cap,
-            weight_schedule=spec.weight_schedule,
-            weight_power=spec.weight_power,
-            weight_cutoff=spec.weight_cutoff,
-            corrupt_mode=corrupt_mode,
-            windowed_state=state_store is not None,
-            forensics=forensics,
-        ))
+        self._corrupt_mode = corrupt_mode
+        self._forensics = bool(forensics)
+        # Live actuator values (the control plane's hooks below).  They
+        # start at the spec's statics and only the controller moves them
+        # — spec stays frozen provenance, these are the running truth,
+        # checkpointed via host_state so a resume re-applies them.
+        self.agg_every = int(spec.agg_every)
+        self.weight_cutoff = int(spec.weight_cutoff)
+        self.quarantine: frozenset = frozenset()
+        self.arrivals_quarantined = 0
+        self._build_cycle()
         # Per-event training keys fold (seed, tick, client) off this base
         # — the async analogue of the sync driver's split chain, with no
         # chain state to checkpoint.
@@ -192,6 +194,81 @@ class AsyncEngine:
         # so they replay identically across kill-and-resume.
         self.last_clients: Any = None      # (K,) np.int32 registered ids
         self.last_staleness: Any = None    # (K,) np.int32 staleness
+
+    def _build_cycle(self) -> None:
+        """(Re)jit the cycle program against the LIVE weight_cutoff —
+        the one actuator build_cycle closure-captures, so a controller
+        move on it rebuilds the dispatch (a new jit cache entry; the
+        agg_every shape change retraces within the same wrapper)."""
+        self._cycle = jax.jit(build_cycle(
+            self.fed_round, staleness_cap=self.spec.staleness_cap,
+            weight_schedule=self.spec.weight_schedule,
+            weight_power=self.spec.weight_power,
+            weight_cutoff=self.weight_cutoff,
+            corrupt_mode=self._corrupt_mode,
+            windowed_state=self.state_store is not None,
+            forensics=self._forensics,
+        ))
+
+    # -- control-plane actuator hooks ----------------------------------------
+    # All four are host-side and deterministic: they touch only host
+    # metadata (plus one re-jit), never a traced value mid-flight, and
+    # every applied value rides host_state so kill-and-resume replays
+    # the controlled trajectory bit-identically.
+
+    def set_agg_every(self, k: int) -> None:
+        """Shrink/adjust the aggregation cadence K (cycle fires every K
+        unique-client buffered events)."""
+        k = int(k)
+        if not (1 <= k <= self.num_clients):
+            raise ValueError(
+                f"agg_every must be in [1, {self.num_clients}], got {k}")
+        if self.buffer.capacity < k:
+            raise ValueError(
+                f"agg_every={k} exceeds buffer capacity "
+                f"{self.buffer.capacity}")
+        self.agg_every = k
+
+    def set_buffer_capacity(self, capacity: int) -> None:
+        """Grow the bounded arrival buffer, carrying pending events
+        over (the control plane only grows it, so the restore always
+        fits)."""
+        capacity = int(capacity)
+        if capacity < max(self.agg_every, self.buffer.fill):
+            raise ValueError(
+                f"buffer capacity {capacity} < max(agg_every="
+                f"{self.agg_every}, pending fill {self.buffer.fill})")
+        pending = self.buffer.state()
+        self.buffer = UpdateBuffer(capacity)
+        self.buffer.restore(pending)
+
+    def set_weight_cutoff(self, cutoff: int) -> None:
+        """Relax (or tighten) the staleness weight cutoff — rebuilds the
+        cycle dispatch (the cutoff is closure-captured static)."""
+        cutoff = int(cutoff)
+        if cutoff < 0:
+            raise ValueError(f"weight_cutoff must be >= 0, got {cutoff}")
+        if cutoff == self.weight_cutoff:
+            return
+        self.weight_cutoff = cutoff
+        self._build_cycle()
+
+    def set_quarantine(self, clients) -> None:
+        """Mask a client set out of aggregation at INGEST: their
+        arrivals are counted (``arrivals_quarantined``) and advance
+        their pulled version — the client keeps working, the server
+        discards the delivery — but are never buffered.  Zero re-jit,
+        pure host filtering."""
+        q = frozenset(int(c) for c in clients)
+        bad = sorted(c for c in q if not (0 <= c < self.num_clients))
+        if bad:
+            raise ValueError(f"quarantine ids out of range: {bad}")
+        if self.num_clients - len(q) < self.agg_every:
+            raise ValueError(
+                f"quarantining {len(q)}/{self.num_clients} clients "
+                f"leaves fewer than agg_every={self.agg_every} eligible "
+                "— the cycle could never fill")
+        self.quarantine = q
 
     # -- realization ---------------------------------------------------------
 
@@ -223,7 +300,7 @@ class AsyncEngine:
     def advance_until_ready(self) -> None:
         """Advance the virtual clock until the buffer holds one
         aggregation batch (``agg_every`` unique-client events)."""
-        k = self.spec.agg_every
+        k = self.agg_every
         start = self.tick
         while self.buffer.unique_clients() < k:
             if self.tick - start > self.spec.max_ticks_per_cycle:
@@ -241,6 +318,14 @@ class AsyncEngine:
                 lanes = np.nonzero(arrivals[w])[0]
                 for c in map(int, lanes):
                     self.arrivals_total += 1
+                    if c in self.quarantine:
+                        # Control-plane quarantine: the delivery is
+                        # discarded at ingest.  Like the dropout path the
+                        # client still pulls the current version — its
+                        # send was refused, its clock wasn't.
+                        self.arrivals_quarantined += 1
+                        self.client_versions[c] = self.version
+                        continue
                     if drops[w, c]:
                         # Chaos dropout: the delivery was lost in flight.
                         # The client still pulls the current version and
@@ -271,8 +356,9 @@ class AsyncEngine:
         device_metrics)``; the host ingest digest lands in
         :attr:`last_info`."""
         spec = self.spec
+        cycle_start_tick = self.tick
         self.advance_until_ready()
-        events = self.buffer.take_cycle(spec.agg_every)
+        events = self.buffer.take_cycle(self.agg_every)
         staleness = np.asarray(
             [self.version - ev.version for ev in events], np.int32)
         clients = np.asarray([ev.client for ev in events], np.int32)
@@ -291,13 +377,13 @@ class AsyncEngine:
 
             if float(np.asarray(staleness_weights(
                     "cutoff", staleness,
-                    cutoff=spec.weight_cutoff)).sum()) == 0.0:
+                    cutoff=self.weight_cutoff)).sum()) == 0.0:
                 import warnings
 
                 warnings.warn(
                     f"async cycle at version {self.version}: every "
                     f"buffered row exceeds weight_cutoff="
-                    f"{spec.weight_cutoff} (staleness "
+                    f"{self.weight_cutoff} (staleness "
                     f"{staleness.tolist()}) — the aggregation batch is "
                     "fully discarded and the server takes a zero step",
                     RuntimeWarning, stacklevel=2)
@@ -350,7 +436,7 @@ class AsyncEngine:
             minlength=spec.staleness_cap + 2)
         self.last_info = {
             "tick": int(self.tick),
-            "events": int(spec.agg_every),
+            "events": int(self.agg_every),
             "staleness_mean": float(staleness.mean()),
             "staleness_max": int(staleness.max()),
             # Buckets 0..H plus one ">H" overflow bucket.
@@ -360,6 +446,11 @@ class AsyncEngine:
             "arrivals_dropped": int(self.arrivals_dropped),
             "buffer_overflow": int(self.buffer_overflow),
             "arrival_seed": int(spec.seed),
+            # Deterministic ingest sensor (pure in (seed, tick)): how
+            # much virtual time this cycle spent collecting its batch —
+            # the ingest_stall watchdog rule's field.
+            "cycle_ticks": int(self.tick - cycle_start_tick),
+            "arrivals_quarantined": int(self.arrivals_quarantined),
         }
         return state, metrics
 
@@ -381,6 +472,14 @@ class AsyncEngine:
             "arrivals_total": int(self.arrivals_total),
             "arrivals_dropped": int(self.arrivals_dropped),
             "buffer_overflow": int(self.buffer_overflow),
+            # Control-plane live actuator values + quarantine set: the
+            # restored engine must resume under the CONTROLLED config,
+            # not the spec statics, or the trajectory forks.
+            "agg_every": int(self.agg_every),
+            "buffer_capacity": int(self.buffer.capacity),
+            "weight_cutoff": int(self.weight_cutoff),
+            "quarantine": sorted(self.quarantine),
+            "arrivals_quarantined": int(self.arrivals_quarantined),
         }
 
     def restore_host_state(self, payload: Dict[str, Any]) -> None:
@@ -392,7 +491,22 @@ class AsyncEngine:
         self.tick = int(payload["tick"])
         self.version = int(payload["version"])
         self.client_versions = np.asarray(versions, np.int64)
-        self.buffer = UpdateBuffer(self.spec.effective_capacity)
+        # Live actuator values first (pre-control checkpoints carry
+        # none — .get falls back to the spec statics), then the buffer
+        # at the RESTORED capacity.
+        self.agg_every = int(payload.get("agg_every",
+                                         self.spec.agg_every))
+        self.quarantine = frozenset(
+            int(c) for c in payload.get("quarantine") or ())
+        self.arrivals_quarantined = int(
+            payload.get("arrivals_quarantined", 0))
+        cutoff = int(payload.get("weight_cutoff",
+                                 self.spec.weight_cutoff))
+        if cutoff != self.weight_cutoff:
+            self.weight_cutoff = cutoff
+            self._build_cycle()
+        self.buffer = UpdateBuffer(int(payload.get(
+            "buffer_capacity", self.spec.effective_capacity)))
         self.buffer.restore(payload.get("buffer") or [])
         self.arrivals_total = int(payload.get("arrivals_total", 0))
         self.arrivals_dropped = int(payload.get("arrivals_dropped", 0))
@@ -413,4 +527,10 @@ class AsyncEngine:
         self.arrivals_total = 0
         self.arrivals_dropped = 0
         self.buffer_overflow = 0
+        self.agg_every = int(self.spec.agg_every)
+        self.quarantine = frozenset()
+        self.arrivals_quarantined = 0
+        if self.weight_cutoff != int(self.spec.weight_cutoff):
+            self.weight_cutoff = int(self.spec.weight_cutoff)
+            self._build_cycle()
         self.last_info = {}

@@ -1,0 +1,29 @@
+"""Closed-loop control plane: watchdog events -> bounded actuator moves.
+
+PR 12 built the sensors (anomaly watchdog, flight recorder), PR 13 the
+actuators (async ``agg_every``, arrival buffer, staleness weights),
+PR 16 the per-client reputation ledger — this package closes the loop:
+
+- :mod:`blades_tpu.control.policy` — the PURE decision layer: a frozen
+  :class:`ControlPolicy` rule table mapping watchdog rule names to
+  bounded, one-directional actuator moves, plus the ``decide_*``
+  functions shared by the live path and the offline
+  ``tools/replay_round.py --action`` re-derivation.
+- :mod:`blades_tpu.control.controller` — the per-trial
+  :class:`Controller`: cooldowns, the quarantine-and-probe state
+  machine, and the action journal, all threaded through checkpoints.
+
+Arm it with ``config.control(enabled=True, ...)``; see README
+"Control plane".
+"""
+
+from blades_tpu.control.controller import Controller  # noqa: F401
+from blades_tpu.control.policy import (  # noqa: F401
+    ACTION_ACTUATORS,
+    ACTUATOR_FAMILIES,
+    DEFAULT_RULE_TABLE,
+    LIFECYCLE_RULE,
+    ControlAction,
+    ControlPolicy,
+    rederive_action,
+)

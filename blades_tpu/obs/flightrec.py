@@ -56,6 +56,12 @@ REPLAY_FIELDS = (
     # the diagnosis stream, so replay reproduces them bit-for-bit.
     "suspected_fraction", "flagged_churn", "reputation_p10",
     "reputation_p50", "reputation_p90", "ledger_clients_seen",
+    # Control plane (blades_tpu/control): the deterministic ingest
+    # sensor and the controller's journal/quarantine telemetry — all
+    # pure in (config, seed, event stream), so a replayed controlled
+    # trajectory reproduces them bit-for-bit.
+    "cycle_ticks", "arrivals_quarantined", "control_actions_total",
+    "quarantine_size",
 )
 
 #: Wall-clock / run-shape fields dropped from digests — they vary run to

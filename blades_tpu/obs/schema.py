@@ -86,6 +86,15 @@ ROUND_RECORD_FIELDS: Dict[str, Tuple[tuple, bool]] = {
     "arrivals_dropped": ((int,), False),
     "updates_per_sec": (_NUM, False),
     "arrival_seed": ((int,), False),
+    # cycle_ticks is the DETERMINISTIC ingest sensor: virtual ticks the
+    # arrival process consumed filling this cycle's aggregation buffer
+    # (pure in (arrival_seed, tick), unlike updates_per_sec) — the
+    # ingest_stall watchdog rule and with it the control plane's
+    # buffer-growth response key off it.  arrivals_quarantined is the
+    # cumulative count of arrivals dropped at ingest because their
+    # client sat in the controller's quarantine set.
+    "cycle_ticks": ((int,), False),
+    "arrivals_quarantined": ((int,), False),
     # Out-of-core per-client state (blades_tpu/state): participation-
     # window staging telemetry, stamped host-side by the driver on
     # windowed (and async out-of-core) rounds.  state_store names the
@@ -168,6 +177,16 @@ ROUND_RECORD_FIELDS: Dict[str, Tuple[tuple, bool]] = {
     # watchdog fired; list-typed, so the CSV sink skips it like the
     # nested dicts.
     "watchdog_events": ((list,), False),
+    # Closed-loop control plane (blades_tpu/control): journaled
+    # controller decisions for this round.  control_actions is the list
+    # of action dicts (seq, round, tick, rule, actuator, old, new,
+    # clients, until, pre, message — list-typed, CSV sink skips it);
+    # control_actions_total the cumulative journal length (monotone,
+    # replay-comparable); quarantine_size the post-step quarantine set
+    # size.  Present only on controller-armed rounds.
+    "control_actions": ((list,), False),
+    "control_actions_total": ((int,), False),
+    "quarantine_size": ((int,), False),
     # defense forensics (obs/forensics.py)
     "byz_precision": (_NUM, False),
     "byz_recall": (_NUM, False),
@@ -256,6 +275,14 @@ def validate_record(record: Any) -> Dict[str, Any]:
         for i, ev in enumerate(events):
             if not isinstance(ev, dict):
                 problems.append(f"watchdog_events[{i}] must be a dict")
+    actions = record.get("control_actions")
+    if isinstance(actions, list):
+        for i, act in enumerate(actions):
+            if not isinstance(act, dict):
+                problems.append(f"control_actions[{i}] must be a dict")
+            elif not {"seq", "actuator", "rule"} <= set(act):
+                problems.append(
+                    f"control_actions[{i}] must carry seq/actuator/rule")
     hist = record.get("staleness_hist")
     if isinstance(hist, list):
         for i, v in enumerate(hist):

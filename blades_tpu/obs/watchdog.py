@@ -128,6 +128,16 @@ def default_rules() -> tuple:
         WatchdogRule(name="ingest_collapse", kind="collapse",
                      field="updates_per_sec", window=8, min_points=4,
                      factor=4.0),
+        # ingest_stall: the deterministic twin of ingest_collapse —
+        # virtual ticks consumed filling the aggregation buffer this
+        # cycle (cycle_ticks) spiked vs the rolling median, i.e. the
+        # arrival process needed far more simulated time to produce a
+        # cohort.  Pure in (seed, tick), so controller responses keyed
+        # to it replay bit-identically (updates_per_sec reads the span
+        # clock and cannot).
+        WatchdogRule(name="ingest_stall", kind="spike",
+                     field="cycle_ticks", window=8, min_points=4,
+                     factor=3.0),
         # Client-lifetime ledger (obs/ledger.py): reputation drift.
         # Inert unless the ledger stamps its fields (absent => skipped).
         # reputation_collapse: the fleet's median reputation fell off a
@@ -148,6 +158,45 @@ def default_rules() -> tuple:
                      field="flagged_churn", window=8, min_points=4,
                      factor=4.0),
     )
+
+
+def rules_from_config(specs) -> tuple:
+    """Build a rule tuple from config data (the ``watchdog_rules`` knob
+    / ``--watchdog-rules`` JSON): a sequence of dicts (or ready
+    :class:`WatchdogRule` instances), fail-fast on unknown keys — and,
+    via ``WatchdogRule.__post_init__``, on unknown kinds and fields not
+    registered in the schema.  Called at config.validate() time so a
+    typo'd rule dies before anything compiles."""
+    if specs is None:
+        return default_rules()
+    allowed = {f.name for f in dataclasses.fields(WatchdogRule)}
+    rules = []
+    for i, spec in enumerate(specs):
+        if isinstance(spec, WatchdogRule):
+            rules.append(spec)
+            continue
+        if not isinstance(spec, dict):
+            raise ValueError(
+                f"watchdog_rules[{i}] must be a dict of WatchdogRule "
+                f"fields, got {type(spec).__name__}")
+        unknown = set(spec) - allowed
+        if unknown:
+            raise ValueError(
+                f"watchdog_rules[{i}]: unknown key(s) {sorted(unknown)}; "
+                f"allowed: {sorted(allowed)}")
+        missing = {"name", "kind", "field"} - set(spec)
+        if missing:
+            raise ValueError(
+                f"watchdog_rules[{i}]: missing required key(s) "
+                f"{sorted(missing)}")
+        rules.append(WatchdogRule(**spec))
+    names = [r.name for r in rules]
+    dupes = sorted({n for n in names if names.count(n) > 1})
+    if dupes:
+        raise ValueError(
+            f"watchdog_rules: duplicate rule name(s) {dupes} — rolling "
+            "windows are keyed by name")
+    return tuple(rules)
 
 
 def _median(values: Sequence[float]) -> float:
@@ -205,6 +254,26 @@ class Watchdog:
         events = self._evaluate(row)
         self.events.extend(events)
         return events
+
+    # -- checkpoint threading (the controller path: the driver owns the
+    # watchdog and has no on-disk rows to warm() from, so rolling state
+    # rides the training checkpoint explicitly) --------------------------
+
+    def state(self) -> Dict[str, Any]:
+        return {
+            "windows": {name: list(w) for name, w in self._windows.items()},
+            "last_step_total": self._last_step_total,
+        }
+
+    def restore_state(self, state: Dict[str, Any]) -> None:
+        self.reset()
+        for name, values in (state.get("windows") or {}).items():
+            window = self._windows.get(name)
+            if window is None:
+                continue  # rule set changed across resume; start cold
+            window.extend(float(v) for v in values)
+        last = state.get("last_step_total")
+        self._last_step_total = None if last is None else float(last)
 
     # -- evaluation ----------------------------------------------------------
 

@@ -140,10 +140,14 @@ def _adversary_volumes(adversary: Optional[str], n: int,
     reduction on the width-sharded layout: every chip holds full rows of
     its own columns.  Lazy (the BLADE-FL free-rider) is collective-free
     too: its victim pick is a keyed draw over the LANE axis (replicated
-    per shard) and its camouflage noise is per-coordinate."""
+    per shard) and its camouflage noise is per-coordinate.  The campaign
+    adversaries (DiurnalALIE, LazyRamp) inherit their parents' geometry —
+    benign mean/std coordinate stats plus per-LANE tick schedules — so
+    they are collective-free too (validate() pins them to the async
+    path, but the model must cover every registered name)."""
     f4 = 4
     if adversary in (None, "ALIE", "IPM", "Adaptive", "Noise", "SignFlip",
-                     "LabelFlip", "Lazy"):
+                     "LabelFlip", "Lazy", "DiurnalALIE", "LazyRamp"):
         return []
     if adversary == "MinMax":
         # pairwise dists among benign rows + one distance-norm psum per

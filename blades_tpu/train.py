@@ -41,6 +41,14 @@ def main(argv=None) -> int:
                         "regression) over the already-fetched rows; "
                         "events land in metrics rows as watchdog_events "
                         "and trigger the flight-recorder dump")
+    common.add_argument("--watchdog-rules", default=None, metavar="JSON",
+                        help="replace the watchdog's built-in rule table "
+                        "with a JSON list of rule specs, e.g. "
+                        "'[{\"name\": \"acc\", \"kind\": \"collapse\", "
+                        "\"field\": \"test_acc\"}]' (kinds: nonfinite, "
+                        "spike, ceiling, collapse, round_time_regression); "
+                        "implies --watchdog; validated fail-fast before "
+                        "any trial starts (see README \"Control plane\")")
     common.add_argument("--flightrec-rounds", type=int, default=16,
                         metavar="K",
                         help="flight recorder (obs/flightrec.py): ring of "
@@ -183,6 +191,27 @@ def main(argv=None) -> int:
     scan_window = (args.scan_window if args.scan_window == "auto"
                    else int(args.scan_window))
 
+    # --watchdog-rules: parse + validate BEFORE building experiments so a
+    # typo'd rule spec dies here, not 40 minutes into a sweep.  The parsed
+    # list rides the same `watchdog=` channel (a sequence arms the
+    # watchdog with exactly these rules; a bool arms the defaults).
+    watchdog = args.watchdog
+    if args.watchdog_rules is not None:
+        try:
+            specs = json.loads(args.watchdog_rules)
+        except json.JSONDecodeError as exc:
+            parser.error(f"--watchdog-rules is not valid JSON: {exc}")
+        if not isinstance(specs, list):
+            parser.error("--watchdog-rules must be a JSON list of rule "
+                         f"specs, got {type(specs).__name__}")
+        from blades_tpu.obs.watchdog import rules_from_config
+
+        try:
+            rules_from_config(specs)  # fail-fast validation only
+        except (ValueError, TypeError) as exc:
+            parser.error(f"--watchdog-rules: {exc}")
+        watchdog = specs
+
     from blades_tpu.tune import load_experiments_from_file, run_experiments
 
     if args.cmd == "file":
@@ -215,7 +244,7 @@ def main(argv=None) -> int:
                 autotune=args.autotune,
                 plan_cache_dir=args.plan_cache_dir,
                 trace_dir=args.trace_dir,
-                watchdog=args.watchdog,
+                watchdog=watchdog,
                 flightrec_rounds=args.flightrec_rounds,
             )
 
@@ -254,7 +283,7 @@ def main(argv=None) -> int:
                 autotune=args.autotune,
                 plan_cache_dir=args.plan_cache_dir,
                 trace_dir=args.trace_dir,
-                watchdog=args.watchdog,
+                watchdog=watchdog,
                 flightrec_rounds=args.flightrec_rounds,
             )
 

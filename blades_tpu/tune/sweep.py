@@ -456,13 +456,17 @@ def _resolve_watchdog(watchdog):
     state is per trial)."""
     if not watchdog:
         return None
-    from blades_tpu.obs.watchdog import Watchdog, default_rules
+    from blades_tpu.obs.watchdog import (Watchdog, default_rules,
+                                         rules_from_config)
 
     if watchdog is True or watchdog == "on":
         return default_rules()
     if isinstance(watchdog, Watchdog):
         return watchdog.rules
-    return tuple(watchdog)
+    # A sequence of WatchdogRule instances and/or rule DICTS (the
+    # --watchdog-rules JSON surface) — rules_from_config fail-fasts on
+    # unknown keys/kinds/fields.
+    return rules_from_config(list(watchdog))
 
 
 # Row fields mirrored onto the dispatch span as provenance args, so a
@@ -591,9 +595,15 @@ def _run_lane_group(
         ) as logger:
             for row in rows:
                 row = _jsonable(row)
-                events = wd.observe(row) if wd is not None else []
-                if events:
-                    row["watchdog_events"] = [e.as_dict() for e in events]
+                if "watchdog_events" in row:
+                    # Controlled driver: events already stamped (see the
+                    # sequential path's comment).
+                    events = list(row["watchdog_events"] or [])
+                else:
+                    events = [e.as_dict() for e in
+                              (wd.observe(row) if wd is not None else [])]
+                    if events:
+                        row["watchdog_events"] = events
                 f.write(json.dumps({**row, "trial": tname}) + "\n")
                 logger.log(row)
                 if flightrec is not None:
@@ -601,7 +611,7 @@ def _run_lane_group(
                     trig = flightrec.check(row)
                     if trig is None and events:
                         trig = {"kind": "watchdog",
-                                "rules": [e.rule for e in events],
+                                "rules": [e["rule"] for e in events],
                                 "round": row.get("training_iteration")}
                     if trig is not None:
                         flightrec.dump(trig)
@@ -1068,11 +1078,21 @@ def run_experiments(
                             for result in rows:
                                 result["trial"] = tname
                                 row = _jsonable(result)
-                                events = (wd.observe(row)
-                                          if wd is not None else [])
-                                if events:
-                                    row["watchdog_events"] = [
-                                        e.as_dict() for e in events]
+                                if "watchdog_events" in row:
+                                    # Controlled driver (blades_tpu/
+                                    # control): it owns its own watchdog
+                                    # and stamped the events — observing
+                                    # again would double-fire the
+                                    # rolling rules.
+                                    events = list(
+                                        row["watchdog_events"] or [])
+                                else:
+                                    events = [
+                                        e.as_dict() for e in
+                                        (wd.observe(row)
+                                         if wd is not None else [])]
+                                    if events:
+                                        row["watchdog_events"] = events
                                 f.write(json.dumps(row) + "\n")
                                 logger.log(row)
                                 if flightrec is not None:
@@ -1081,7 +1101,7 @@ def run_experiments(
                                     if trig is None and events:
                                         trig = {
                                             "kind": "watchdog",
-                                            "rules": [e.rule
+                                            "rules": [e["rule"]
                                                       for e in events],
                                             "round": row.get(
                                                 "training_iteration"),
@@ -1321,6 +1341,13 @@ def run_experiments(
                     "events": len(wd.events),
                     "rules": sorted({e.rule for e in wd.events}),
                 }
+            control = getattr(algo, "control_summary", None)
+            if control:
+                # Closed-loop controller digest (blades_tpu/control):
+                # actions journaled, live actuator view, quarantine/
+                # probation sets — the full journal rides the rows'
+                # control_actions.
+                summary["control"] = control
             if flightrec is not None and flightrec.dumps:
                 summary["flightrec"] = {
                     "dumps": flightrec.dumps,

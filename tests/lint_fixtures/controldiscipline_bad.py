@@ -1,0 +1,27 @@
+"""Seeded control-discipline violations (blades-lint fixture, never
+imported): device fetches and raw wall-clock inside a controller-style
+policy decision — decisions must be pure functions of (policy,
+pre-state, already-fetched sensor row, round, tick), or the journal
+stops being re-derivable by ``replay_round.py --action``.  Scanned only
+when the test instantiates the passes with this path (the real passes
+scan blades_tpu/control/ via DEVICE_SIDE / the trace-discipline
+prefix)."""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def leaky_decide(policy, row, scores):
+    suspects = np.asarray(row["lane_scores"])  # BAD: fetches the device lanes instead of reading the stamped host row
+    worst = float(jnp.max(scores))  # BAD: device reduction blocks the dispatch pipeline mid-decision
+    fired = jax.device_get(row["suspected_fraction"])  # BAD: per-round device_get in a decision
+    return suspects, worst, fired
+
+
+def leaky_cooldown(controller, events):
+    now = time.time()  # BAD: wall-clock cooldown — actions stop being pure in (round, tick), resume diverges
+    stamp = time.perf_counter()  # BAD: raw clock read invisible to the span tree
+    controller.last_fire = now
+    return [e for e in events if now - controller.last_fire > 5], stamp

@@ -1,0 +1,25 @@
+"""Clean controller code (blades-lint fixture, never imported): policy
+decisions consume the stamped host row the driver already fetched,
+cooldowns count ROUNDS (virtual time), and nothing reads a device array
+or a wall clock — the shape ``replay_round.py --action`` can re-derive
+bit-identically."""
+
+
+def disciplined_decide(policy, row):
+    # The sensor row is host data by contract: the driver stamps
+    # suspected_fraction / ledger_top_suspects from its own batched
+    # fetch before the controller ever sees the row.
+    fired = float(row.get("suspected_fraction") or 0.0)
+    suspects = [int(c) for c in row.get("ledger_top_suspects") or ()]
+    return (suspects[:policy.quarantine_max]
+            if fired > policy.threshold else [])
+
+
+def disciplined_cooldown(controller, round_idx, family):
+    # Round-indexed cooldown: pure in the round counter, so a resumed
+    # trial re-derives the identical gate from the checkpointed state.
+    if round_idx < controller.cooldown_until.get(family, -1):
+        return False
+    controller.cooldown_until[family] = \
+        round_idx + controller.policy.cooldown_rounds
+    return True

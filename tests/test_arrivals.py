@@ -332,6 +332,47 @@ def test_async_kill_and_resume_bit_identical(tmp_path):
         assert np.array_equal(np.asarray(pa), np.asarray(pc))
 
 
+def test_rate_schedule_resume_reenters_at_restored_tick(tmp_path):
+    """ISSUE 17 regression: a kill-and-resume mid-``rate_schedule`` must
+    re-enter the schedule at the RESTORED tick, not tick 0 — campaign
+    adversaries ride arrival schedules, so a schedule that rewound on
+    resume would silently decouple the attack from the traffic shape.
+    ``rate_at`` is pure in the absolute tick, so the contract reduces to
+    the engine restoring its tick exactly; rows across the schedule
+    boundary must match a straight-through run bit-for-bit."""
+    sched = {"arrivals": {"rate": 0.9,
+                          "rate_schedule": ((4, 0.1),)}}
+
+    def cfg():
+        return _async_config(**json.loads(json.dumps(sched)))
+
+    _, rows_a = _run_rows(cfg, 10)
+    # The run must actually cross the schedule boundary for the test to
+    # bite: the high->low rate flip at tick 4 stretches the tick gaps.
+    assert rows_a[-1]["tick"] > 4 > rows_a[0]["tick"]
+
+    b = cfg().build()
+    for _ in range(4):
+        b.train()
+    b.save_checkpoint(str(tmp_path))
+    c = cfg().build()
+    c.load_checkpoint(str(tmp_path))
+    restored_tick = c._async.host_state()["tick"]
+    # The restored engine evaluates the schedule at its restored tick —
+    # a rewound process would read the pre-boundary 0.9 after tick 12.
+    proc = c._async.spec.process()
+    assert float(proc.rate_at(restored_tick)) == float(
+        proc.rate_at(b._async.host_state()["tick"]))
+    rows_c = [c.train() for _ in range(6)]
+    for ra, rc in zip(rows_a[4:], rows_c):
+        for k in _REPLAYABLE:
+            assert ra[k] == rc[k], k
+    # And the post-boundary regime is visibly the scheduled one: at rate
+    # 0.1 the virtual clock must advance faster per cycle than the
+    # rate-0.9 opening (more ticks to buffer agg_every events).
+    assert float(proc.rate_at(rows_c[-1]["tick"])) == pytest.approx(0.1)
+
+
 def test_async_chaos_dropout_and_corruption_compose():
     """Chaos composes with arrivals: dropout deterministically thins the
     ingest stream (counted, replayable), NaN corruption rides an event

@@ -159,6 +159,41 @@ def test_ledger_discipline_fixtures():
     assert run_fixture([hs_good], "ledgerdiscipline_good.py") == []
 
 
+def test_control_discipline_fixtures():
+    """ISSUE 17: the host-sync pass covers the control plane's decision
+    path (blades_tpu/control/ rides DEVICE_SIDE) — policy decisions must
+    be pure over already-fetched sensor rows, so a device fetch mid-
+    decision is a finding, and a wall-clock cooldown (actions no longer
+    pure in (round, tick) ⇒ the journal stops re-deriving) is the
+    trace-discipline half of the same contract."""
+    from tools.lint.passes.host_sync import DEVICE_SIDE
+
+    assert "blades_tpu/control/policy.py" in DEVICE_SIDE
+    assert "blades_tpu/control/controller.py" in DEVICE_SIDE
+    hs = HostSyncPass(modules=[f"{FIX}/controldiscipline_bad.py"])
+    bad = errors_of(run_fixture([hs], "controldiscipline_bad.py"),
+                    "host-sync")
+    msgs = "\n".join(f.message for f in bad)
+    assert "np.asarray()" in msgs
+    assert "float() on an array expression" in msgs
+    assert "jax.device_get()" in msgs
+    assert len(bad) == 3
+    tp = TraceDisciplinePass(prefixes=[f"{FIX}/controldiscipline_bad.py"])
+    clocks = errors_of(run_fixture([tp], "controldiscipline_bad.py"),
+                       "trace-discipline")
+    cmsgs = "\n".join(f.message for f in clocks)
+    assert "time.time()" in cmsgs
+    assert "time.perf_counter()" in cmsgs
+    assert len(clocks) == 2
+    # Clean twin: host-row reads + round-indexed cooldowns are silent
+    # under BOTH passes.
+    hs_good = HostSyncPass(modules=[f"{FIX}/controldiscipline_good.py"])
+    assert run_fixture([hs_good], "controldiscipline_good.py") == []
+    tp_good = TraceDisciplinePass(
+        prefixes=[f"{FIX}/controldiscipline_good.py"])
+    assert run_fixture([tp_good], "controldiscipline_good.py") == []
+
+
 def test_static_args_fixtures():
     sa = StaticArgsPass(prefixes=[f"{FIX}/static_bad.py"])
     bad = errors_of(run_fixture([sa], "static_bad.py"), "static-config")

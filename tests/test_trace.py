@@ -146,7 +146,7 @@ def test_watchdog_rules_are_schema_driven():
     assert {r.name for r in default_rules()} == {
         "nan_aggregate", "nan_loss", "update_norm_spike",
         "fpr_collapse", "round_time_regression",
-        "staleness_runaway", "ingest_collapse",
+        "staleness_runaway", "ingest_collapse", "ingest_stall",
         "reputation_collapse", "flagger_churn"}
 
 

@@ -56,6 +56,16 @@ DEVICE_SIDE = (
     # ALREADY-FETCHED rows are the sanctioned boundary and carry
     # per-line pragmas; any new sync is a finding.
     "blades_tpu/obs/ledger.py",
+    # Control plane (ISSUE 17): policy decisions and the controller's
+    # step() run once per round on the driver thread between dispatches
+    # over ALREADY-FETCHED rows — an unsanctioned device fetch there
+    # stalls the pipeline like any other, and worse: it would smuggle
+    # device state into decisions the replay contract says are pure in
+    # (policy, pre-state, sensor row, round, tick), making the journal
+    # non-rederivable.  Raw wall-clock in decisions is the same hazard
+    # and is already frozen out repo-wide by trace-discipline.
+    "blades_tpu/control/policy.py",
+    "blades_tpu/control/controller.py",
     "blades_tpu/ops/aggregators.py",
     "blades_tpu/ops/clustering.py",
     "blades_tpu/ops/layout.py",

@@ -136,6 +136,67 @@ def compare(row: dict, recorded: dict):
     return matches, mismatches, skipped
 
 
+def rederive_actions(dump: dict, quiet: bool = False) -> int:
+    """``--action``: re-derive every journaled control action from the
+    dump's policy config and each action's recorded decision inputs
+    (``pre`` + the row's ledger suspects), and diff against the journal
+    entry — byte-for-byte over the serialized dicts.  No training is
+    re-run: actions are pure in (policy, pre-state, sensor data, round,
+    tick), so a diff here means the control plane's determinism contract
+    is broken, independent of the numeric replay.  Returns an exit code
+    (0 = every action re-derived identically)."""
+    from blades_tpu.control import ControlPolicy, rederive_action
+
+    cfg = dump.get("config") or {}
+    control_cfg = cfg.get("control_config")
+    if not control_cfg:
+        print("dump's config has no control_config — nothing to "
+              "re-derive (run was uncontrolled)", file=sys.stderr)
+        return 1
+    policy = ControlPolicy.from_config(dict(control_cfg))
+    # The flight recorder nests the fleet size under dataset_config
+    # (it dumps the run's serialized config); accept the flat key too so
+    # hand-built forensic dumps keep working.
+    num_clients = int(
+        cfg.get("num_clients")
+        or (cfg.get("dataset_config") or {}).get("num_clients")
+        or 0)
+    checked = diverged = 0
+    for row in dump.get("rounds") or []:
+        if not isinstance(row, dict):
+            continue
+        suspects = row.get("ledger_top_suspects") or ()
+        for entry in row.get("control_actions") or []:
+            rederived = rederive_action(
+                policy, entry, suspects=suspects,
+                num_clients=num_clients)
+            checked += 1
+            want = json.dumps(entry, sort_keys=True)
+            have = (None if rederived is None
+                    else json.dumps(rederived, sort_keys=True))
+            if want != have:
+                diverged += 1
+                print(f"  round {row.get('training_iteration')} seq "
+                      f"{entry.get('seq')} [{entry.get('actuator')}]: "
+                      f"recorded {want}\n    != rederived {have}  "
+                      "MISMATCH")
+            elif not quiet:
+                print(f"  round {row.get('training_iteration')} seq "
+                      f"{entry.get('seq')} [{entry.get('actuator')}] "
+                      f"{entry.get('rule')}: rederived OK")
+    if diverged:
+        print(f"{diverged}/{checked} control action(s) DIVERGED — the "
+              "control plane's determinism contract is broken",
+              file=sys.stderr)
+        return 1
+    if not checked:
+        print("no control actions recorded in the dump's window "
+              "(controlled run, but every ring round was action-free)")
+        return 0
+    print(f"all {checked} control action(s) re-derived bit-identically")
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="tools.replay_round",
@@ -145,6 +206,13 @@ def main(argv=None) -> int:
     p.add_argument("dump", help="path to a flightrec.json dump")
     p.add_argument("--tick", type=int, default=None,
                    help="round to replay (default: the trigger round)")
+    p.add_argument("--action", action="store_true",
+                   help="instead of re-running the round, re-derive "
+                   "every journaled control action (blades_tpu/control) "
+                   "from the dump's policy config + each action's "
+                   "recorded decision inputs and diff against the "
+                   "journal — the control plane's half of the replay "
+                   "contract; no training happens")
     p.add_argument("--quiet", action="store_true")
     args = p.parse_args(argv)
 
@@ -157,6 +225,8 @@ def main(argv=None) -> int:
         return 1
     with open(args.dump) as f:
         dump = json.load(f)
+    if args.action:
+        return rederive_actions(dump, quiet=args.quiet)
     try:
         row, recorded = replay(dump, tick=args.tick)
     except (ValueError, KeyError) as exc:
