@@ -33,7 +33,12 @@ Two measured workloads, one JSON line:
    backends.  And env-gated ``BLADES_BENCH_LEDGER``: the same protocol
    with the client-lifetime ledger (``blades_tpu/obs/ledger.py``)
    folding the full cohort every round vs bare, held to the PR 12 <2%
-   overhead bar, on both backends.)
+   overhead bar, on both backends.  And env-gated ``BLADES_BENCH_MESH``:
+   hierarchical-vs-flat A/B on the 8-device ``(4, 2)`` pod mesh —
+   ``parallel/hier.py`` per-chip robust pre-aggregation vs the flat
+   GSPMD round — stamping the trace-time ``ici_bytes`` next to the
+   wall times; runs LAST on both backends because it may re-provision
+   the device count.)
 2. **ResNet-18 @ 768 clients** (the model BASELINE.json actually names):
    768 is the single-chip capacity limit under malicious-lane elision —
    the benign-compacted bf16 update matrix stores 576 rows = 12.9 GB
@@ -1027,6 +1032,98 @@ def _async_block(cpu: bool) -> dict:
     return _measure_async_cnn(timed_cycles=timed)
 
 
+def _measure_mesh_arm(hier: bool, *, num_clients, model, input_shape,
+                      dataset, timed_rounds, n_devices=8,
+                      mesh_shape=None) -> dict:
+    """One arm of the BLADES_BENCH_MESH A/B (ISSUE 18) through the FULL
+    driver: the flat GSPMD mesh round (``num_devices`` alone) vs the
+    hierarchical pod-scale round (``execution='hier'`` on a 2-D
+    ``(clients, d)`` mesh — per-chip pre-aggregation, ring gather of
+    representatives).  With the default ``bucket_size=1`` the hier arm
+    is bit-identical to the single-chip dense trajectory (the tier-1
+    pinned contract); vs the flat GSPMD arm the losses agree only to
+    float32 reduction-order tolerance.  The hier arm additionally
+    stamps its trace-time ``ici_bytes``."""
+    from blades_tpu.algorithms import FedavgConfig
+
+    cfg = (
+        FedavgConfig()
+        .data(dataset=dataset, num_clients=num_clients, seed=0)
+        .training(global_model=model, server_lr=0.5,
+                  train_batch_size=BATCH,
+                  num_batch_per_round=LOCAL_STEPS,
+                  aggregator={"type": "Median"},
+                  input_shape=input_shape)
+        .client(lr=0.1)
+        .adversary(num_malicious_clients=num_clients // 4,
+                   adversary_config={"type": "ALIE"})
+        .evaluation(evaluation_interval=0)
+    )
+    res = dict(num_devices=n_devices)
+    if hier:
+        res.update(execution="hier", mesh_shape=mesh_shape)
+    cfg.resources(**res)
+    algo = cfg.build()
+    try:
+        row = algo.train()  # compile + settle outside the timed loop
+        t0 = time.perf_counter()
+        for _ in range(timed_rounds):
+            row = algo.train()
+        dt = time.perf_counter() - t0
+        final_loss = float(row["train_loss"])
+        assert final_loss == final_loss  # NaN guard
+        out = {
+            "rounds_per_sec": round(timed_rounds / dt, 4),
+            "round_s": round(dt / timed_rounds, 4),
+            "clients": num_clients, "model": model,
+            "batch": BATCH, "local_steps": LOCAL_STEPS,
+            "timed_rounds": timed_rounds, "aggregator": "Median",
+            "adversary": "ALIE", "n_devices": n_devices,
+            "path": "hier" if hier else "flat_gspmd",
+            "final_loss": final_loss,
+        }
+        if hier:
+            out["mesh_shape"] = row.get("mesh_shape")
+            out["ici_bytes"] = row.get("ici_bytes")
+            out["preagg_kept"] = row.get("preagg_kept")
+        return out
+    finally:
+        algo.stop()
+
+
+def _mesh_block(cpu: bool) -> dict:
+    """BLADES_BENCH_MESH satellite (ISSUE 18): hierarchical-vs-flat
+    mesh A/B on an 8-device ``(4, 2)`` torus, riding TPU main and the
+    cpu_fallback box (8 virtual CPU devices via the dryrun provisioning
+    recipe).  bucket_size=1 pins hier to the dense trajectory, so the
+    wall-time delta is the collective schedule and the stamped
+    ``ici_bytes`` is the wire cost the hierarchy actually paid; the
+    two arms' losses are cross-checked to reduction-order tolerance."""
+    from __graft_entry__ import _provision_devices
+
+    _provision_devices(8)
+    if cpu:
+        kw = dict(num_clients=16, model="mlp", dataset="mnist",
+                  input_shape=None, timed_rounds=2)
+    else:
+        kw = dict(num_clients=64, model="cnn", dataset="cifar10",
+                  input_shape=None, timed_rounds=3)
+    flat = _measure_mesh_arm(False, **kw)
+    hier = _measure_mesh_arm(True, mesh_shape=(4, 2), **kw)
+    out = {"flat": flat, "hier": hier}
+    if flat["rounds_per_sec"]:
+        out["hier_over_flat"] = round(
+            hier["rounds_per_sec"] / flat["rounds_per_sec"], 3)
+    if flat.get("final_loss") is not None:
+        # bucket_size=1 pins hier to the dense trajectory; the flat
+        # GSPMD arm differs only by float32 reduction order, so the
+        # delta is a cheap sanity stamp, not an identity claim.
+        delta = abs(hier["final_loss"] - flat["final_loss"])
+        out["loss_delta"] = delta
+        out["loss_agree_1e4"] = delta < 1e-4
+    return out
+
+
 def _measure_ooc_round(backend: str, *, num_clients=32, window=8,
                        num_byzantine=8, timed_rounds=3, model="cnn",
                        dataset="cifar10", adversary="ALIE",
@@ -1293,6 +1390,15 @@ def _cpu_fallback(probe_err: str) -> None:
             out["control"] = _control_block(cpu=True)
         except Exception as e:
             out["control"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+    if os.environ.get("BLADES_BENCH_MESH", "1") == "1":
+        try:
+            # Pod-scale federation (ISSUE 18): hierarchical-vs-flat
+            # mesh A/B on 8 virtual CPU devices.  Runs LAST:
+            # _provision_devices may clear backends to widen the
+            # device count, invalidating arrays earlier blocks hold.
+            out["mesh"] = _mesh_block(cpu=True)
+        except Exception as e:
+            out["mesh"] = {"error": f"{type(e).__name__}: {e}"[:300]}
     _emit(out)
 
 
@@ -1443,6 +1549,17 @@ def main() -> None:
             out["control"] = _control_block(cpu=False)
         except Exception as e:
             out["control"] = {"error": f"{type(e).__name__}: {e}"[:500]}
+
+    if os.environ.get("BLADES_BENCH_MESH", "1") == "1":
+        try:
+            # Pod-scale federation (ISSUE 18): hierarchical robust
+            # aggregation on the (clients, d) 2-D mesh vs the flat
+            # GSPMD round, ici_bytes stamped from the trace-time
+            # recorder.  Runs LAST: _provision_devices may clear
+            # backends when the box has fewer than 8 devices.
+            out["mesh"] = _mesh_block(cpu=False)
+        except Exception as e:
+            out["mesh"] = {"error": f"{type(e).__name__}: {e}"[:500]}
 
     _emit(out)
 

@@ -252,6 +252,20 @@ class FedavgConfig:
         self.fltrust_root_size: int = 100
         # resources
         self.num_devices: Optional[int] = None
+        # Pod-scale 2-D device layout (parallel/mesh.py): a (clients, d)
+        # axis pair tiling exactly num_devices chips — client blocks
+        # shard along "clients", the hierarchical gather splits along
+        # "d".  None keeps the canonical 1-D (clients,) mesh, so every
+        # existing multi-chip config is unchanged.  Set via
+        # .resources(mesh_shape=(c, dd)).
+        self.mesh_shape: Optional[tuple] = None
+        # Hierarchical pre-aggregation (execution="hier", ops/preagg.py):
+        # the per-shard robust reduction flavor ("bucket" = s-bucketing
+        # means, "nnm" = nearest-neighbor mixing) and its one size knob.
+        # bucket_size=1 is the identity pre-agg for BOTH flavors — the
+        # hierarchical round is then bit-identical to single-chip dense.
+        self.preagg: str = "bucket"
+        self.bucket_size: int = 1
         self._frozen = False
         # Packing decision from the last get_fed_round() resolution
         # (requested/pack_factor/packed_lanes/fallback) — surfaced in
@@ -338,7 +352,8 @@ class FedavgConfig:
                   d_chunk=None, update_dtype=None, compute_dtype=None,
                   client_packing=None, mxu_finish=None, autotune=None,
                   autotune_cache_dir=None, tuned_plan=None,
-                  state_store=None, window=None, state_dir=None):
+                  state_store=None, window=None, state_dir=None,
+                  mesh_shape=None, preagg=None, bucket_size=None):
         """``state_store=`` / ``window=`` / ``state_dir=`` configure the
         out-of-core participation-window store (blades_tpu/state):
         ``window`` is the per-round cohort size (``0`` = stateless
@@ -356,7 +371,8 @@ class FedavgConfig:
                          mxu_finish=mxu_finish, autotune=autotune,
                          autotune_cache_dir=autotune_cache_dir,
                          tuned_plan=tuned_plan, state_store=state_store,
-                         state_dir=state_dir)
+                         state_dir=state_dir, mesh_shape=mesh_shape,
+                         preagg=preagg, bucket_size=bucket_size)
 
     def fault_tolerance(self, *, health_check=None, faults=None):
         """In-round failure detection / elastic recovery (core/health.py)
@@ -537,9 +553,9 @@ class FedavgConfig:
             self.num_classes = _NUM_CLASSES[name]
             self._inferred.add("num_classes")
         if self.execution not in ("auto", "dense", "streamed", "dsharded",
-                                  "async"):
+                                  "async", "hier"):
             raise ValueError(
-                "execution must be auto|dense|streamed|dsharded|async, "
+                "execution must be auto|dense|streamed|dsharded|async|hier, "
                 f"got {self.execution!r}"
             )
         if self.async_config and self.execution != "async":
@@ -562,9 +578,10 @@ class FedavgConfig:
                 )
             if self.num_devices and self.num_devices > 1:
                 raise ValueError(
-                    "execution='async' is single-chip for now: the cycle "
-                    "program has no mesh formulation — run without "
-                    "num_devices or use a synchronous path"
+                    "execution='async' × num_devices>1 is an unsupported "
+                    "pair: the buffered cycle program has no mesh "
+                    "formulation — set .resources(num_devices=None), or "
+                    "use a synchronous execution path on the mesh"
                 )
             # Defense forensics COMPOSES with async since the cohort-
             # shaped forensics work: the cycle diagnoses the (K, d)
@@ -614,11 +631,57 @@ class FedavgConfig:
                 )
             # rounds_per_dispatch > 1 chains k d-sharded rounds in one
             # lax.scan'ed program (parallel/dsharded.dsharded_multi_step).
+        # Pod-scale knobs (parallel/hier.py): fail-fast on every
+        # structural impossibility, naming the exact pair and the knob
+        # that flips it.
+        from blades_tpu.ops.preagg import PREAGG_FLAVORS
+
+        if self.preagg not in PREAGG_FLAVORS:
+            raise ValueError(
+                f"preagg must be one of {PREAGG_FLAVORS}, got "
+                f"{self.preagg!r}")
+        if not isinstance(self.bucket_size, int) or self.bucket_size < 1:
+            raise ValueError(
+                f"bucket_size must be an int >= 1, got {self.bucket_size!r}")
+        if self.mesh_shape is not None:
+            ms = tuple(int(v) for v in self.mesh_shape)
+            if len(ms) != 2 or min(ms) < 1:
+                raise ValueError(
+                    f"mesh_shape must be a (clients, d) pair of positive "
+                    f"ints, got {self.mesh_shape!r}")
+            self.mesh_shape = ms
+            if not self.num_devices or self.num_devices < 2:
+                raise ValueError(
+                    "mesh_shape × single-chip is an unsupported pair: the "
+                    "2-D (clients, d) layout tiles a multi-chip mesh — "
+                    "set .resources(num_devices=...) > 1, or drop "
+                    "mesh_shape")
+            if ms[0] * ms[1] != self.num_devices:
+                raise ValueError(
+                    f"mesh_shape {ms[0]}x{ms[1]} must tile exactly "
+                    f"num_devices={self.num_devices} chips — fix one of "
+                    "the two in .resources(...)")
+        if self.execution == "hier":
+            if not self.num_devices or self.num_devices < 2:
+                raise ValueError(
+                    "execution='hier' pre-aggregates per chip and gathers "
+                    "representatives over a mesh; set "
+                    ".resources(num_devices=...) > 1"
+                )
+            if int(self.rounds_per_dispatch or 1) != 1:
+                raise ValueError(
+                    "execution='hier' × rounds_per_dispatch>1 is an "
+                    "unsupported pair: the hierarchical round is dispatched "
+                    "per-round (no chained-scan formulation yet) — set "
+                    "rounds_per_dispatch=1, or use a flat mesh path"
+                )
         if self.execution == "streamed":
             if self.num_devices and self.num_devices > 1:
                 raise ValueError(
-                    "execution='streamed' is the single-chip giant-federation "
-                    "path; use the mesh (num_devices>1) for multi-chip"
+                    "execution='streamed' × num_devices>1 is an unsupported "
+                    "pair: streamed is the single-chip giant-federation "
+                    "path — set .resources(num_devices=None), or use a "
+                    "mesh execution (dsharded/hier) for multi-chip"
                 )
             # rounds_per_dispatch > 1 chains k streamed rounds through the
             # dispatch pipeline with no host sync between them
@@ -653,12 +716,36 @@ class FedavgConfig:
                     "within the dense budget) or disable faults"
                 )
             if self.num_devices and self.num_devices > 1:
-                raise ValueError(
-                    "fault injection is single-chip for now: the "
-                    "participation mask under shard_map would shard the "
-                    "lane axis — run the chaos pass without num_devices, "
-                    "or disable faults"
-                )
+                # The hierarchical path gathers the full update matrix
+                # replicated before injection, so the chaos layer
+                # composes there — as long as the pre-aggregation keeps
+                # matrix height (kept == n) and no straggler ring is
+                # configured (the stale buffer is sized per LANE).
+                if self.execution != "hier":
+                    raise ValueError(
+                        "fault injection × num_devices>1 is an "
+                        "unsupported pair on the flat mesh paths: the "
+                        "participation mask under shard_map would shard "
+                        "the lane axis — set .resources(num_devices=None) "
+                        "or .resources(execution='hier'), or drop faults"
+                    )
+                injector = self.get_fault_injector()
+                if injector is not None and injector.needs_stale_buffer:
+                    raise ValueError(
+                        "execution='hier' × straggler faults is an "
+                        "unsupported pair: the stale ring buffer is "
+                        "sized per lane and has no hierarchical "
+                        "formulation — set num_stragglers=0, or run "
+                        "single-chip"
+                    )
+                if self.preagg == "bucket" and self.bucket_size != 1:
+                    raise ValueError(
+                        "execution='hier' × fault injection needs an "
+                        "identity-height pre-aggregation (bucketing with "
+                        f"bucket_size={self.bucket_size} shrinks the "
+                        "matrix) — set .resources(bucket_size=1) or "
+                        "preagg='nnm', or drop faults"
+                    )
         if self.codec_config:
             # Build the codec now so a bad spec fails at validate() time
             # (CodecConfig.__post_init__ range-checks every knob).
@@ -768,10 +855,10 @@ class FedavgConfig:
                     "carries its own per-client state threading")
             if self.num_devices and self.num_devices > 1:
                 raise ValueError(
-                    "window=0 (stateless clients) is single-chip for "
-                    "now: the width-sharded round 'auto' may pick on a "
-                    "mesh threads per-client state through its own "
-                    "body — run without num_devices or drop window=0")
+                    "window=0 (stateless clients) × num_devices>1 is an "
+                    "unsupported pair: the mesh rounds thread per-client "
+                    "state through their own bodies — set "
+                    ".resources(num_devices=None), or drop window=0")
         if w is not None and w >= 1:
             if w > self.num_clients:
                 raise ValueError(
@@ -787,9 +874,10 @@ class FedavgConfig:
                     "execution='dense'")
             if self.num_devices and self.num_devices > 1:
                 raise ValueError(
-                    "the participation-window store is single-chip for "
-                    "now: cohort gather/scatter has no mesh formulation "
-                    "— run without num_devices or drop the window")
+                    f"state_window={w} × num_devices>1 is an unsupported "
+                    "pair: cohort gather/scatter has no mesh formulation "
+                    "— set .resources(num_devices=None), or drop the "
+                    "window")
             # Forensics COMPOSES with the window since the cohort-shaped
             # forensics work: the windowed round diagnoses the
             # (window, d) cohort matrix against the cohort-gathered
@@ -950,8 +1038,10 @@ class FedavgConfig:
                 )
             if self.num_devices and self.num_devices > 1:
                 raise ValueError(
-                    "client_packing is single-chip (no mesh formulation); "
-                    "run without num_devices or drop the packing"
+                    "client_packing × num_devices>1 is an unsupported "
+                    "pair: the grouped-kernel lanes have no mesh "
+                    "formulation — set .resources(num_devices=None), or "
+                    ".resources(client_packing='off')"
                 )
             if self.execution in ("streamed", "dsharded"):
                 raise ValueError(
@@ -970,12 +1060,27 @@ class FedavgConfig:
             )
         self.autotune_mode  # fail-fast on a bad autotune value
         if self.autotune_mode:
-            if self.num_devices and self.num_devices > 1:
+            # Multi-chip tuning is legal (ISSUE 18): the plan space keeps
+            # the config's own mesh resolution as candidates[0] and the
+            # reassociating tier offers mesh_shape/collective switches.
+            # Only an EXPLICIT execution='hier' pin conflicts — there the
+            # path is already chosen and the tuner has nothing mesh-free
+            # to baseline against.
+            if self.execution == "hier":
                 raise ValueError(
-                    "the execution autotuner is single-chip for now: its "
-                    "plan space covers the dense/streamed single-chip "
-                    "paths — run the tuned pass without num_devices, or "
-                    "disable autotune"
+                    "autotune × execution='hier' is an unsupported pair: "
+                    "the tuner selects INTO the hierarchical path via its "
+                    "collective knob (reassociating tier) — set "
+                    ".resources(execution='auto') to let it, pin the plan "
+                    "via tuned_plan, or disable autotune"
+                )
+            if self.execution == "dsharded":
+                raise ValueError(
+                    "autotune × execution='dsharded' is an unsupported "
+                    "pair: the plan space has no d-sharded vocabulary (a "
+                    "plan would silently rewrite the pin) — set "
+                    ".resources(autotune='off'), or drop the explicit "
+                    "execution pin"
                 )
         if self.tuned_plan is not None:
             # Parse the pin now so a bad plan dict fails at validate()

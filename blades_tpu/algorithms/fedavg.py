@@ -143,6 +143,7 @@ class Fedavg:
         self._prefetcher = None   # set by _setup_dense_pipeline when active
         self._cache_wrappers = []  # CachedFunctions feeding the obs counters
         self._async = None        # AsyncEngine under execution="async"
+        self._hier_recorder = None  # PassRecorder under execution="hier"
         self.mesh = None
         # Client permutation applied to the stacked arrays (d-sharded
         # elision layout); None = natural order.  Checkpoints record it
@@ -187,7 +188,9 @@ class Fedavg:
             from blades_tpu.parallel import make_mesh, shard_federation, sharded_step
             from blades_tpu.parallel.sharded import sharded_evaluate, sharded_multi_step
 
-            self.mesh = make_mesh(num_devices=cfg.num_devices)
+            self.mesh = make_mesh(num_devices=cfg.num_devices,
+                                  mesh_shape=getattr(cfg, "mesh_shape", None))
+            use_hier = cfg.execution == "hier"
             use_dsharded = cfg.execution == "dsharded" or (
                 cfg.execution == "auto" and self._dsharded_auto()
             )
@@ -209,14 +212,36 @@ class Fedavg:
                 self._test_arrays = tuple(a[order]
                                           for a in self._test_arrays)
                 self.malicious = self.malicious[order]
-            self.state, arrays = shard_federation(
-                self.mesh, self.state, self._train_arrays + (self.malicious,)
-            )
-            self._train_arrays, self.malicious = arrays[:3], arrays[3]
+            if use_hier:
+                # Hierarchical path: data + client state shard P(clients),
+                # but the malicious mask stays REPLICATED and UNPADDED —
+                # hier_step pads and slices it inside the traced program
+                # (dense-mirroring RNG needs the true client count).
+                from blades_tpu.parallel import replicated_sharding
+
+                self.state, self._train_arrays = shard_federation(
+                    self.mesh, self.state, self._train_arrays
+                )
+                self.malicious = jax.device_put(
+                    self.malicious, replicated_sharding(self.mesh))
+            else:
+                self.state, arrays = shard_federation(
+                    self.mesh, self.state,
+                    self._train_arrays + (self.malicious,)
+                )
+                self._train_arrays, self.malicious = arrays[:3], arrays[3]
             _, self._test_arrays = shard_federation(
                 self.mesh, self.state, self._test_arrays
             )
-            if use_dsharded:
+            if use_hier:
+                from blades_tpu.parallel import hier_step
+
+                self._step, self._hier_recorder = hier_step(
+                    self.fed_round, self.mesh,
+                    preagg=getattr(cfg, "preagg", "bucket"),
+                    bucket_size=int(getattr(cfg, "bucket_size", 1)),
+                )
+            elif use_dsharded:
                 from blades_tpu.parallel.dsharded import (dsharded_multi_step,
                                                           dsharded_step)
 
@@ -831,11 +856,39 @@ class Fedavg:
                     state_stores.append(alt)
         state_windows = [getattr(cfg, "state_window", None)]
 
+        # Pod-scale mesh knobs (ISSUE 18): multi-chip tuning keeps the
+        # config's own mesh resolution as candidates[0] — a
+        # mesh_shape=None plan never touches the device layout, so every
+        # pre-pod plan_id stays byte-identical — and the reassociating
+        # tier offers the hierarchical collective (and the 2-D torus
+        # that carries it).  The d-sharded formulation has no plan
+        # vocabulary: an explicit pin is rejected at validate() time,
+        # and an 'auto' resolution to it must fail loudly here rather
+        # than be silently retuned onto the flat dense mesh.
+        nd = int(cfg.num_devices or 1)
+        if nd > 1 and cfg.execution == "auto" and self._dsharded_auto():
+            raise ValueError(
+                "autotune × execution='auto'-resolved-to-dsharded is an "
+                "unsupported pair: the plan space has no d-sharded "
+                "vocabulary — pin .resources(execution='dsharded') "
+                "without autotune, or shrink the federation into the "
+                "dense budget")
+        base_ms = getattr(cfg, "mesh_shape", None)
+        mesh_shapes = [tuple(base_ms) if base_ms else None]
+        collectives = ["ring"]
+        if nd > 1 and allow_reassociating:
+            hier_ms = tuple(base_ms) if base_ms else (nd, 1)
+            if hier_ms not in mesh_shapes:
+                mesh_shapes.append(hier_ms)
+            collectives.append("hier")
+
         return at.enumerate_plans(
             executions=execs, d_chunks=d_chunks, mxu_modes=mxu_modes,
             pack_factors=packs, scan_windows=windows,
             prefetch_options=prefetch_options, agg_domains=agg_domains,
             state_stores=state_stores, state_windows=state_windows,
+            mesh_shapes=mesh_shapes, collectives=collectives,
+            num_devices=nd,
             allow_reassociating=allow_reassociating,
         )
 
@@ -1300,6 +1353,16 @@ class Fedavg:
             # per-statistic baseline (parallel/streamed_geometry.py).
             row["hbm_passes"] = int(metrics["hbm_passes"])
             row["hbm_passes_unfused"] = int(metrics["hbm_passes_unfused"])
+        if "ici_bytes" in metrics:
+            # Pod-scale ICI accounting (parallel/hier.py): per-round wire
+            # bytes counted at trace time on the PassRecorder, plus the
+            # pre-aggregated matrix height and the engaged device layout
+            # — host-side stamps, the hbm_passes pattern.
+            row["ici_bytes"] = int(metrics["ici_bytes"])
+            row["preagg_kept"] = int(metrics["preagg_kept"])
+            ms = getattr(self.config, "mesh_shape", None) or \
+                (int(self.config.num_devices or 1), 1)
+            row["mesh_shape"] = f"{int(ms[0])}x{int(ms[1])}"
         if "elided_lanes" in metrics:
             # Malicious-lane training elision engaged (streamed/d-sharded
             # paths): surfaces the optimistic num_unhealthy basis — an

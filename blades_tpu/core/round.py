@@ -428,6 +428,45 @@ class FedRound:
                 updates, stale, participation, straggled, _corrupted = (
                     self.faults.inject(updates, stale, state.server.round)
                 )
+        return self.finish_dense(
+            state, updates, client_opt, losses, malicious,
+            k_adv, k_agg, k_dp,
+            participation=participation, straggled=straggled,
+            stale=stale, residual=residual,
+        )
+
+    def finish_dense(
+        self,
+        state: RoundState,
+        updates: jax.Array,
+        client_opt,
+        losses: jax.Array,
+        malicious: jax.Array,
+        k_adv: jax.Array,
+        k_agg: jax.Array,
+        k_dp: jax.Array,
+        *,
+        participation=None,
+        straggled=None,
+        stale=None,
+        residual=None,
+        loss_benign=None,
+    ) -> Tuple[RoundState, dict]:
+        """The dense aggregation tail of :meth:`step_prebatched` — health
+        check, DP, adversary forge, trusted row, robust aggregate, server
+        step and the metrics dict — over an already-assembled ``(n, d)``
+        update matrix.  Split out so the hierarchical multi-chip round
+        (:mod:`blades_tpu.parallel.hier`) can run the IDENTICAL finish
+        over its gathered representative matrix: under an identity
+        pre-aggregation the whole mesh round is then bit-identical to
+        the single-chip dense program by construction.
+
+        ``loss_benign`` decouples the train-loss mask from ``malicious``
+        for callers whose ``updates`` rows are not 1:1 with ``losses``
+        rows (hier with ``bucket_size>1``: updates are bucket
+        representatives, losses stay per-lane) — ``None`` keeps the
+        dense behavior (``~malicious``).
+        """
         healthy = None
         if self.health_check:
             from blades_tpu.core.health import sanitize_updates
@@ -468,7 +507,8 @@ class FedRound:
                     trusted_update=trusted_update,
                     participation=participation,
                 )
-        benign = (~malicious).astype(jnp.float32)
+        benign = ((~malicious) if loss_benign is None
+                  else loss_benign).astype(jnp.float32)
         if participation is not None:
             # Loss and norm summaries cover the lanes that reported: a
             # dropped lane's local round ran (shape regularity) but its

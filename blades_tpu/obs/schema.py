@@ -155,6 +155,15 @@ ROUND_RECORD_FIELDS: Dict[str, Tuple[tuple, bool]] = {
     # fused/unfused ratio is visible in metrics.jsonl without a TPU.
     "hbm_passes": ((int,), False),
     "hbm_passes_unfused": ((int,), False),
+    # Pod-scale hierarchical round (parallel/hier.py): per-round ICI
+    # wire bytes (trace-time static — counted on the PassRecorder while
+    # the round program was built, reconciled both ways against
+    # parallel/comm_model.hier_round_volumes), the pre-aggregated
+    # matrix height the global defense actually saw, and the engaged
+    # (clients, d) device layout as "CxD".
+    "ici_bytes": ((int,), False),
+    "preagg_kept": ((int,), False),
+    "mesh_shape": ((str,), False),
     # perf layer (blades_tpu/perf): AOT executable-cache traffic,
     # cumulative per trial — a trial whose round program was served from
     # the cache reports misses == 0 from its first row.

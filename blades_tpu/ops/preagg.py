@@ -1,0 +1,76 @@
+"""Per-shard robust pre-aggregation primitives (ByzFL arXiv:2505.24802).
+
+The hierarchical pod-scale round (:mod:`blades_tpu.parallel.hier`) reduces
+each chip's local ``(n_local, d)`` update block to ``m`` representatives
+before the global defense runs over the gathered ``(c*m, d)`` matrix.  Two
+flavors, both controlled by ONE ``bucket_size`` knob and both exactly the
+identity at ``bucket_size=1`` (the property the hierarchical-vs-dense
+bit-identity tests pin):
+
+- ``bucket`` — s-bucketing: consecutive lanes average in groups of
+  ``bucket_size``; ``m = ceil(n_local / bucket_size)``.  Reassociates the
+  defense (a mean runs *inside* each bucket before the robust aggregator
+  sees anything), which provably *tightens* the effective Byzantine
+  fraction when buckets mix benign and malicious rows.
+- ``nnm`` — nearest-neighbor mixing: every lane is replaced by the mean
+  of its ``bucket_size`` nearest local rows (itself included, L2 on the
+  raw updates); ``m = n_local``.  Denoises benign rows toward their local
+  cluster without changing the matrix height.
+
+Ghost (padding) lanes are handled by an explicit ``real`` mask: bucketing
+takes a masked mean (an all-ghost bucket yields a zero row, sliced away by
+the caller's static ``kept`` count); NNM gives ghost rows infinite distance
+so they are never mixed into a real lane's neighborhood.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+PREAGG_FLAVORS = ("bucket", "nnm")
+
+
+def bucket_count(n_local: int, bucket_size: int) -> int:
+    """Representatives a ``bucket`` pre-agg emits per chip (static)."""
+    return -(-int(n_local) // int(bucket_size))
+
+
+def bucket_representatives(updates, real, bucket_size: int):
+    """Masked bucket means: ``(n_local, d) -> (m, d)``, ``m = ceil(n/b)``.
+
+    ``real`` is the ``(n_local,)`` bool mask of non-ghost lanes.  Each
+    bucket averages its REAL members only (ghost rows are zeroed before
+    the sum, so a NaN ghost update cannot poison a boundary bucket); a
+    bucket with no real member yields a zero row.  ``bucket_size=1`` is
+    bit-exact identity on real lanes: ``sum`` over a singleton axis and
+    division by 1.0 both return the row unchanged.
+    """
+    b = int(bucket_size)
+    n_local, d = updates.shape
+    m = bucket_count(n_local, b)
+    pad = m * b - n_local
+    u = jnp.pad(updates, ((0, pad), (0, 0)))
+    w = jnp.pad(real, (0, pad)).astype(updates.dtype)
+    u = jnp.where(w[:, None] > 0, u, jnp.zeros_like(u))
+    u = u.reshape(m, b, d)
+    w = w.reshape(m, b, 1)
+    return u.sum(axis=1) / jnp.maximum(w.sum(axis=1), 1.0)
+
+
+def nnm_representatives(updates, real, bucket_size: int):
+    """Nearest-neighbor mixing: ``(n_local, d) -> (n_local, d)``.
+
+    Row ``i`` becomes the mean of the ``bucket_size`` locally-nearest
+    rows by squared L2 (self-distance 0, so the row itself is always in
+    its own neighborhood).  Ghost columns get infinite distance and are
+    never selected; ghost ROWS still emit (garbage) output at their own
+    index — the caller's static ``kept`` slice removes them, exactly as
+    with bucketing.  ``bucket_size=1`` is bit-exact identity on real
+    lanes: the sole neighbor is the row itself.
+    """
+    k = int(bucket_size)
+    sq = ((updates[:, None, :] - updates[None, :, :]) ** 2).sum(axis=-1)
+    sq = jnp.where(real[None, :], sq, jnp.inf)
+    _, idx = lax.top_k(-sq, k)
+    return updates[idx].sum(axis=1) / jnp.float32(k)

@@ -27,6 +27,11 @@ program:
 - :func:`streamed_step` — the single-chip fallback for the same memory
   wall: bf16 update matrix, client-block ``lax.map`` training, d-chunked
   forge+aggregate (coordinate-wise suite only).
+- :func:`hier_step` — the pod-scale formulation: a 2-D ``(clients, d)``
+  mesh where each chip robustly pre-aggregates its local client block to
+  ``m`` representatives (bucketing / nearest-neighbor mixing) before ONE
+  ring all-gather feeds the global defense — dense-mirroring RNG, so
+  ``bucket_size=1`` is bit-identical to the single-chip round.
 
 Orthogonally, :mod:`blades_tpu.parallel.packed` raises arithmetic
 intensity PER LANE on the dense path: client lane-packing folds P narrow
@@ -45,6 +50,7 @@ from blades_tpu.parallel.mesh import (  # noqa: F401
     shard_federation,
 )
 from blades_tpu.parallel.dsharded import dsharded_step  # noqa: F401
+from blades_tpu.parallel.hier import hier_step  # noqa: F401
 from blades_tpu.parallel.packed import (  # noqa: F401
     ClientPacking,
     resolve_client_packing,

@@ -238,6 +238,58 @@ def dsharded_round_volumes(
     return vols
 
 
+def hier_round_volumes(
+    n: int, d: int, mesh_shape, *, preagg: str = "bucket",
+    bucket_size: int = 1,
+) -> List[tuple]:
+    """Every collective one hierarchical round issues, as
+    ``(CollectiveVolume, ring_size)`` pairs.
+
+    The analytic twin of :func:`blades_tpu.parallel.hier.hier_step`'s
+    trace-time recorder events, computed with its OWN arithmetic from the
+    round geometry (client padding, bucket math, d-axis column padding) —
+    ``tests/test_hier.py`` reconciles the two inventories in both
+    directions, event by event.  Unlike the flat d-sharded round, rings
+    here run over DIFFERENT mesh axes (``clients`` of size ``c``, ``d``
+    of size ``dd``), hence the explicit per-event ring size.
+    """
+    c, dd = int(mesh_shape[0]), int(mesh_shape[1])
+    b = int(bucket_size)
+    f4 = 4
+    n_local = -(-n // c)
+    n_pad = c * n_local
+    m = n_local if preagg == "nnm" else -(-n_local // b)
+    vols = []
+    if dd > 1:
+        d_pad = -(-d // dd) * dd
+        col = d_pad // dd
+        vols.append((CollectiveVolume("reps_gather_clients", "all_gather",
+                                      c * m * col * f4), c))
+        vols.append((CollectiveVolume("reps_gather_d", "all_gather",
+                                      c * m * d_pad * f4), dd))
+    else:
+        vols.append((CollectiveVolume("reps_gather_clients", "all_gather",
+                                      c * m * d * f4), c))
+    vols.append((CollectiveVolume("losses_gather", "all_gather",
+                                  n_pad * f4), c))
+    return vols
+
+
+def hier_wire_bytes(volumes: List[tuple]) -> int:
+    """Per-chip ring wire total for :func:`hier_round_volumes` pairs.
+
+    Exact integer ring arithmetic (``factor * P * (k-1) // k``), matching
+    the PassRecorder's accumulation so the reconciliation is equality,
+    not approximate — :meth:`CollectiveVolume.wire_bytes`'s float factor
+    can differ by 1 byte on non-power-of-two rings.
+    """
+    total = 0
+    for v, k in volumes:
+        factor = 2 if v.kind == "psum" else 1
+        total += factor * v.count * v.payload_bytes * (k - 1) // k
+    return total
+
+
 def wire_bytes_per_chip(volumes: List[CollectiveVolume], n_dev: int) -> int:
     return sum(v.wire_bytes(n_dev) for v in volumes)
 

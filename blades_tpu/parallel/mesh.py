@@ -18,6 +18,12 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 CLIENTS_AXIS = "clients"
+# Second mesh axis of the pod-scale 2-D layout: the model-width (d)
+# dimension.  On a ``(clients, d)`` mesh, client blocks train
+# data-parallel along ``clients`` while the hierarchical aggregation
+# path (parallel/hier.py) splits its representative gather column-wise
+# along ``d`` — a two-phase torus all-gather instead of one long ring.
+D_AXIS = "d"
 
 
 def init_distributed(
@@ -58,10 +64,35 @@ def make_mesh(
     num_devices: Optional[int] = None,
     devices: Optional[Sequence[jax.Device]] = None,
     axis_name: str = CLIENTS_AXIS,
+    mesh_shape: Optional[Sequence[int]] = None,
 ) -> Mesh:
-    """A 1-D device mesh over the client axis."""
+    """A device mesh over the client axis.
+
+    Default: the canonical 1-D ``(clients,)`` mesh.  ``mesh_shape=(c, d)``
+    builds the pod-scale 2-D ``(clients, d)`` layout instead — ``c * d``
+    devices arranged so client blocks shard along ``clients`` and the
+    hierarchical aggregation path can split collectives along ``d``.  A
+    1-D mesh is exactly ``mesh_shape=(n, 1)`` minus the trivial axis, so
+    every existing caller is unchanged.
+    """
     if devices is None:
         devices = jax.devices()
+    if mesh_shape is not None:
+        c, d = (int(mesh_shape[0]), int(mesh_shape[1]))
+        if c < 1 or d < 1:
+            raise ValueError(f"mesh_shape axes must be >= 1, got {mesh_shape}")
+        want = c * d
+        if num_devices is not None and num_devices != want:
+            raise ValueError(
+                f"mesh_shape {c}x{d} needs exactly {want} devices, "
+                f"num_devices requested {num_devices}"
+            )
+        if want > len(devices):
+            raise ValueError(
+                f"mesh_shape {c}x{d} needs {want} devices, have {len(devices)}"
+            )
+        grid = np.asarray(devices[:want]).reshape(c, d)
+        return Mesh(grid, (axis_name, D_AXIS))
     if num_devices is not None:
         if num_devices > len(devices):
             raise ValueError(
@@ -106,7 +137,10 @@ def shard_federation(mesh: Mesh, round_state, data_arrays: Sequence[Any]):
     rep = replicated_sharding(mesh)
     import dataclasses as _dc
 
-    n_dev = mesh.devices.size
+    # Pad to the CLIENTS-axis size, not the total device count: on a 2-D
+    # (clients, d) mesh only the first axis partitions the client stack
+    # (the d axis replicates it), so c shards — not c*d — must tile.
+    n_dev = mesh.shape[CLIENTS_AXIS]
     server = jax.device_put(round_state.server, rep)
     client_opt = jax.tree.map(
         lambda a: jax.device_put(pad_to_multiple(a, n_dev), cs),

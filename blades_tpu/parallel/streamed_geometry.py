@@ -210,6 +210,15 @@ class PassRecorder:
         # rows (weighted sums/medians/coordwise: 1, mean+std: 2,
         # gathers: their column fraction of the width, rounded up).
         self.dequant_rows = 0
+        # ICI accounting (pod-scale hierarchical path, parallel/hier.py):
+        # one event per collective the traced round issues, carrying the
+        # same (kind, payload) vocabulary as parallel/comm_model.py's
+        # CollectiveVolume plus the ring size it runs over — so the
+        # recorder's arithmetic and the model's reconcile event-by-event
+        # in both directions.  ``ici_bytes`` is the per-chip ring wire
+        # total (the ``ici_bytes`` round metric).
+        self.ici_events = []  # [(label, kind, payload_bytes, axis_size)]
+        self.ici_bytes = 0
         self._final = False
 
     def count(self, executed: int, unfused: int, mult: int = 1,
@@ -218,6 +227,19 @@ class PassRecorder:
             self.executed += executed * mult
             self.unfused += unfused * mult
             self.dequant_rows += dequant * mult
+
+    def count_ici(self, label: str, kind: str, payload_bytes: int,
+                  axis_size: int) -> None:
+        """Record one ring collective: ``payload_bytes`` is the TOTAL
+        gathered/reduced payload (the comm-model convention), wire cost
+        per chip follows the ring factors (ag/a2a move ``P*(k-1)/k``,
+        psum ``2P*(k-1)/k``; a 1-chip ring moves nothing)."""
+        if self._final:
+            return
+        k = max(1, int(axis_size))
+        factor = 2 if kind == "psum" else 1
+        self.ici_events.append((str(label), str(kind), int(payload_bytes), k))
+        self.ici_bytes += int(factor * int(payload_bytes) * (k - 1) // k)
 
     def finalize(self) -> None:
         self._final = True

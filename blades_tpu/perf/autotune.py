@@ -103,12 +103,37 @@ class Plan:
     # the default tier was defined over.
     state_store: str = "resident"     # "resident" | "host" | "disk"
     state_window: Optional[int] = None
+    # Pod-scale knobs (ISSUE 18).  ``mesh_shape=None`` is the single-
+    # chip / config-resolved-mesh baseline — the plan does not touch the
+    # device layout at all, so every pre-mesh plan_id stays byte-
+    # identical.  A ``(c, dd)`` pair pins the 2-D ``(clients, d)`` mesh;
+    # ``collective="hier"`` switches the round to the hierarchical
+    # pre-aggregating path (parallel/hier.py) — reassociating tier by
+    # construction, since bucketing reassociates the defense.
+    mesh_shape: Optional[Tuple[int, int]] = None
+    collective: str = "ring"          # "ring" | "hier"
     tier: str = DEFAULT_TIER          # numerics tier this plan belongs to
 
     def __post_init__(self):
         if self.execution not in ("dense", "streamed"):
             raise ValueError(f"plan execution must be dense|streamed, "
                              f"got {self.execution!r}")
+        if self.mesh_shape is not None:
+            ms = tuple(int(v) for v in self.mesh_shape)
+            if len(ms) != 2 or min(ms) < 1:
+                raise ValueError(f"plan mesh_shape must be a (clients, d) "
+                                 f"pair of positive ints, got "
+                                 f"{self.mesh_shape!r}")
+            # Normalise (JSON round-trips lists; the frozen dataclass
+            # must still hash/compare by value for dedupe).
+            object.__setattr__(self, "mesh_shape", ms)
+        if self.collective not in ("ring", "hier"):
+            raise ValueError(f"plan collective must be ring|hier, "
+                             f"got {self.collective!r}")
+        if self.collective == "hier" and self.mesh_shape is None:
+            raise ValueError("plan collective='hier' needs a mesh_shape "
+                             "— the hierarchical path is defined by its "
+                             "(clients, d) mesh")
         if self.state_store not in ("resident", "host", "disk"):
             raise ValueError(f"plan state_store must be resident|host|"
                              f"disk, got {self.state_store!r}")
@@ -148,7 +173,13 @@ class Plan:
                 # store-free id stays byte-identical to the pre-knob
                 # format (the agg_domain discipline).
                 + (f"|ss={self.state_store}w{int(self.state_window)}"
-                   if self.state_window is not None else ""))
+                   if self.state_window is not None else "")
+                # Mesh markers follow the same only-when-engaged
+                # discipline: mesh-free plan ids are byte-identical to
+                # the pre-pod format (regression-pinned).
+                + (f"|mesh={self.mesh_shape[0]}x{self.mesh_shape[1]}"
+                   if self.mesh_shape is not None else "")
+                + ("|hier" if self.collective == "hier" else ""))
 
     def as_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -177,6 +208,13 @@ def apply_plan(config, plan: Plan) -> None:
     """
     config.execution = plan.execution
     config.d_chunk = int(plan.d_chunk)
+    if plan.mesh_shape is not None:
+        # Pod-scale plan: pin the 2-D device layout, and the hierarchical
+        # collective switches the execution path outright (the "hier"
+        # round is a distinct program, not a dense variant).
+        config.mesh_shape = tuple(int(v) for v in plan.mesh_shape)
+        if plan.collective == "hier":
+            config.execution = "hier"
     if plan.state_window is not None:
         # Window pinned by construction (the plan space never varies
         # it); the backend may have been probed, so materialise it.
@@ -241,6 +279,9 @@ def enumerate_plans(
     agg_domains: Sequence[str] = ("f32",),
     state_stores: Sequence[str] = ("resident",),
     state_windows: Sequence[Optional[int]] = (None,),
+    mesh_shapes: Sequence[Optional[Tuple[int, int]]] = (None,),
+    collectives: Sequence[str] = ("ring",),
+    num_devices: int = 1,
     allow_reassociating: bool = False,
     max_candidates: int = MAX_CANDIDATES,
 ) -> PlanSpace:
@@ -266,57 +307,96 @@ def enumerate_plans(
         raise ValueError("executions must name at least the baseline path")
     if not d_chunks:
         raise ValueError("d_chunks must hold at least the baseline chunk")
+    for ms in mesh_shapes:
+        if ms is None:
+            continue
+        if int(num_devices) <= 1:
+            raise ValueError(
+                f"mesh_shape {tuple(ms)} candidates need num_devices > 1 "
+                f"(got {num_devices}) — the pod-scale tier is only legal "
+                "on a multi-chip run")
+        if int(ms[0]) * int(ms[1]) != int(num_devices):
+            raise ValueError(
+                f"mesh_shape {tuple(ms)} must tile exactly "
+                f"{num_devices} devices")
     plans: List[Plan] = []
-    for exe in executions:
-        exe_tier = DEFAULT_TIER if exe == executions[0] else REASSOCIATING_TIER
-        for w in scan_windows:
-            if exe == "streamed":
-                for dc in d_chunks:
-                    for mxu in mxu_modes:
-                        tier = exe_tier
-                        if mxu == "all" and mxu_modes[0] != "all":
-                            tier = REASSOCIATING_TIER
-                        plans.append(Plan(
-                            execution="streamed", d_chunk=int(dc),
-                            client_packing=1, mxu_finish=mxu,
-                            rounds_per_dispatch=int(w), prefetch=False,
-                            tier=tier))
-            else:
-                for p in pack_factors:
-                    for ad in agg_domains:
-                        for ss in state_stores:
-                            for sw in state_windows:
+    # Mesh knobs enumerate OUTERMOST, baseline (no-mesh, ring) first:
+    # with the default (None,)/("ring",) lists the loop collapses to one
+    # iteration and the enumeration order — hence candidates[0] and
+    # every plan_id — is byte-identical to the pre-pod tuner.
+    for ms in mesh_shapes:
+        for coll in collectives:
+            if coll == "hier" and ms is None:
+                continue  # the hierarchical path is defined by its mesh
+            mesh_tier = (DEFAULT_TIER
+                         if ms == mesh_shapes[0] and coll == collectives[0]
+                         else REASSOCIATING_TIER)
+            for exe in executions:
+                exe_tier = (mesh_tier if exe == executions[0]
+                            else REASSOCIATING_TIER)
+                if exe == "streamed" and ms is not None:
+                    continue  # streamed × mesh does not exist
+                for w in scan_windows:
+                    if coll == "hier" and int(w) != 1:
+                        continue  # hier is dispatched per-round (no scan)
+                    if exe == "streamed":
+                        for dc in d_chunks:
+                            for mxu in mxu_modes:
                                 tier = exe_tier
-                                if p != pack_factors[0]:
+                                if mxu == "all" and mxu_modes[0] != "all":
                                     tier = REASSOCIATING_TIER
-                                if ad != agg_domains[0]:
-                                    # Quantized-domain statistics
-                                    # reassociate f32 reductions AND rank
-                                    # on the int8 grid — never a
-                                    # default-tier handout.
-                                    tier = REASSOCIATING_TIER
-                                if (ss != state_stores[0]
-                                        or sw != state_windows[0]):
-                                    # Store backends are bit-identical,
-                                    # but reshaping the staging pipeline
-                                    # is an opt-in probe (ISSUE 15), not
-                                    # a default-tier handout.
-                                    tier = REASSOCIATING_TIER
-                                pres = (prefetch_options if int(w) == 1
-                                        else (False,))
-                                for pre in pres:
-                                    plans.append(Plan(
-                                        execution="dense",
-                                        d_chunk=int(d_chunks[0]),
-                                        client_packing=int(p),
-                                        mxu_finish="",
-                                        rounds_per_dispatch=int(w),
-                                        prefetch=bool(pre),
-                                        agg_domain=str(ad),
-                                        state_store=str(ss),
-                                        state_window=(None if sw is None
-                                                      else int(sw)),
-                                        tier=tier))
+                                plans.append(Plan(
+                                    execution="streamed", d_chunk=int(dc),
+                                    client_packing=1, mxu_finish=mxu,
+                                    rounds_per_dispatch=int(w), prefetch=False,
+                                    tier=tier))
+                    else:
+                        for p in pack_factors:
+                            for ad in agg_domains:
+                                for ss in state_stores:
+                                    for sw in state_windows:
+                                        if coll == "hier" and (
+                                                int(p) != 1 or ad != "f32"
+                                                or sw is not None):
+                                            # packing / wire-domain /
+                                            # window store have no
+                                            # hierarchical formulation
+                                            continue
+                                        tier = exe_tier
+                                        if p != pack_factors[0]:
+                                            tier = REASSOCIATING_TIER
+                                        if ad != agg_domains[0]:
+                                            # Quantized-domain statistics
+                                            # reassociate f32 reductions AND
+                                            # rank on the int8 grid — never a
+                                            # default-tier handout.
+                                            tier = REASSOCIATING_TIER
+                                        if (ss != state_stores[0]
+                                                or sw != state_windows[0]):
+                                            # Store backends are bit-identical,
+                                            # but reshaping the staging pipeline
+                                            # is an opt-in probe (ISSUE 15), not
+                                            # a default-tier handout.
+                                            tier = REASSOCIATING_TIER
+                                        pres = (prefetch_options
+                                                if int(w) == 1
+                                                and coll != "hier"
+                                                else (False,))
+                                        for pre in pres:
+                                            plans.append(Plan(
+                                                execution="dense",
+                                                d_chunk=int(d_chunks[0]),
+                                                client_packing=int(p),
+                                                mxu_finish="",
+                                                rounds_per_dispatch=int(w),
+                                                prefetch=bool(pre),
+                                                agg_domain=str(ad),
+                                                state_store=str(ss),
+                                                state_window=(None if sw is None
+                                                              else int(sw)),
+                                                mesh_shape=ms,
+                                                collective=str(coll),
+                                                tier=tier))
     if not allow_reassociating:
         plans = [p for p in plans if p.tier == DEFAULT_TIER]
     # Dedupe preserving order (e.g. a chunk ladder whose entries clamp

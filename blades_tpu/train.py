@@ -160,14 +160,30 @@ def main(argv=None) -> int:
                        "(default; see README \"Client packing\")")
     p_run.add_argument("--execution", default=None,
                        choices=("auto", "dense", "streamed", "dsharded",
-                                "async"),
+                                "async", "hier"),
                        help="execution path override; 'async' runs the "
                        "buffered-async mode (blades_tpu/arrivals): a "
                        "deterministic Poisson arrival process, clients "
                        "computing against the version they last pulled, "
                        "staleness-weighted robust aggregation every K "
                        "buffered arrivals (see README \"Async buffered "
-                       "execution\")")
+                       "execution\"); 'hier' runs the pod-scale "
+                       "hierarchical round (see README \"Pod-scale "
+                       "federation\")")
+    p_run.add_argument("--mesh-shape", default=None, metavar="CxD",
+                       help="2-D (clients, d) device mesh for multi-chip "
+                       "runs, e.g. '4x2'; must tile num_devices exactly "
+                       "(parallel/mesh.py)")
+    p_run.add_argument("--preagg", default=None,
+                       choices=("bucket", "nnm"),
+                       help="hierarchical per-shard pre-aggregation "
+                       "flavor for --execution hier (ops/preagg.py): "
+                       "'bucket' averages disjoint buckets, 'nnm' mixes "
+                       "each update with its nearest neighbors")
+    p_run.add_argument("--bucket-size", type=int, default=None, metavar="B",
+                       help="pre-aggregation bucket size for --execution "
+                       "hier; 1 (default) is the identity pre-agg — "
+                       "bit-identical to the single-chip dense round")
     p_run.add_argument("--arrivals-json", default=None, metavar="SPEC",
                        help="async arrival spec as JSON for "
                        "--execution async, e.g. '{\"rate\": 0.25, "
@@ -256,6 +272,17 @@ def main(argv=None) -> int:
                                             else int(cp))
         if args.execution is not None:
             run_config["execution"] = args.execution
+        if args.mesh_shape is not None:
+            try:
+                c, dd = args.mesh_shape.lower().split("x")
+                run_config["mesh_shape"] = (int(c), int(dd))
+            except ValueError:
+                parser.error("--mesh-shape must look like '4x2' "
+                             f"(got {args.mesh_shape!r})")
+        if args.preagg is not None:
+            run_config["preagg"] = args.preagg
+        if args.bucket_size is not None:
+            run_config["bucket_size"] = args.bucket_size
         if args.arrivals_json is not None:
             run_config["async_config"] = json.loads(args.arrivals_json)
         if args.state_store is not None:

@@ -478,6 +478,7 @@ _TRACE_ROW_ATTRS = (
     "comm_compression_ratio", "pack_factor", "packed_lanes",
     "elided_lanes", "compile_cache_hits", "compile_cache_misses",
     "dequant_rows", "num_participating", "num_dropped", "num_straggled",
+    "ici_bytes", "preagg_kept", "mesh_shape",
 )
 
 
@@ -657,6 +658,15 @@ def _comm_summary(row: Dict) -> Optional[Dict]:
                                 "agg_domain_bits", "dequant_rows")
             if k in row}
     return comm or None
+
+
+def _mesh_summary(row: Dict) -> Optional[Dict]:
+    """The pod-scale provenance slice for trial summaries (the three
+    hierarchical stamps are static per round under a fixed config, so
+    the last row stands for the trial — the hbm_passes convention)."""
+    mesh = {k: row[k] for k in ("mesh_shape", "ici_bytes", "preagg_kept")
+            if k in row}
+    return mesh if "ici_bytes" in mesh else None
 
 
 def _arrivals_summary(row: Dict) -> Optional[Dict]:
@@ -1327,6 +1337,11 @@ def run_experiments(
                 # Buffered-async ingest digest (blades_tpu/arrivals),
                 # mirrored from the final row like the comm block.
                 summary["arrivals"] = arrivals
+            mesh = _mesh_summary(last_row)
+            if mesh:
+                # Pod-scale hierarchical-round digest (parallel/hier.py),
+                # mirrored from the final row like the comm block.
+                summary["mesh"] = mesh
             packing = getattr(algo, "packing_summary", None)
             if packing:
                 # Lane-packing decision (parallel/packed.py): present

@@ -410,9 +410,13 @@ def test_config_autotune_mode_normalization():
 
 
 def test_config_validate_rejects_bad_autotune_settings():
+    # Multi-chip tuning is legal since the pod-scale tier (ISSUE 18) —
+    # only an EXPLICIT execution='hier' pin conflicts with the tuner.
     cfg = tiny_config()
     cfg.resources(autotune=True, num_devices=2)
-    with pytest.raises(ValueError, match="single-chip"):
+    cfg.validate()
+    cfg.resources(execution="hier")
+    with pytest.raises(ValueError, match="autotune × execution='hier'"):
         cfg.validate()
     cfg2 = tiny_config()
     cfg2.resources(tuned_plan={"execution": "warp"})
@@ -732,3 +736,97 @@ def test_direct_api_resume_warns_on_plan_drift(tmp_path):
     algo2 = cfg2.build()
     with pytest.warns(RuntimeWarning, match="pin the saved plan"):
         algo2.load_checkpoint(str(tmp_path / "ck"))
+
+
+# ---------------------------------------------------------------------------
+# pod-scale plan space (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+
+def test_plan_id_mesh_free_regression_pin():
+    """Mesh-free plan ids are byte-identical to the pre-pod format —
+    the cache key and every historical round row depend on it."""
+    assert Plan().plan_id == "dense|c131072|p1|mxu=off|w1|nopre"
+    assert (Plan(execution="streamed", mxu_finish="counts").plan_id
+            == "streamed|c131072|p1|mxu=counts|w1|nopre")
+
+
+def test_plan_id_mesh_markers_only_when_engaged():
+    assert (Plan(mesh_shape=(4, 2), tier="reassociating").plan_id
+            == "dense|c131072|p1|mxu=off|w1|nopre|mesh=4x2")
+    p = Plan(mesh_shape=(4, 2), collective="hier", tier="reassociating")
+    assert p.plan_id.endswith("|mesh=4x2|hier")
+    assert Plan.from_dict(p.as_dict()) == p
+    # JSON round-trips tuples as lists; normalization restores equality.
+    assert Plan.from_dict({**p.as_dict(), "mesh_shape": [4, 2]}) == p
+    with pytest.raises(ValueError, match="needs a mesh_shape"):
+        Plan(collective="hier")
+    with pytest.raises(ValueError, match="collective"):
+        Plan(collective="mesh")
+
+
+def test_enumerate_mesh_candidates_require_devices_and_opt_in():
+    kw = dict(executions=["dense"], d_chunks=[1 << 17],
+              mesh_shapes=[None, (4, 2)], collectives=["ring", "hier"],
+              num_devices=8)
+    space = enumerate_plans(**kw)  # no opt-in: the mesh tier is absent
+    assert space.baseline == Plan()
+    assert all(p.mesh_shape is None for p in space.candidates)
+    both = enumerate_plans(allow_reassociating=True, **kw)
+    assert both.baseline == Plan()  # baseline-first even with the tier
+    mesh = [p for p in both.candidates if p.mesh_shape is not None]
+    assert mesh and all(p.tier == "reassociating" for p in mesh)
+    assert any(p.collective == "hier" for p in mesh)
+    for p in mesh:
+        if p.collective == "hier":
+            # hier never composes with scan windows / packing / prefetch
+            # / the window store — the dense per-round program only.
+            assert p.rounds_per_dispatch == 1 and p.client_packing == 1
+            assert p.prefetch is False and p.state_window is None
+    with pytest.raises(ValueError, match="num_devices > 1"):
+        enumerate_plans(executions=["dense"], d_chunks=[1 << 17],
+                        mesh_shapes=[(4, 2)])
+    with pytest.raises(ValueError, match="tile exactly"):
+        enumerate_plans(executions=["dense"], d_chunks=[1 << 17],
+                        mesh_shapes=[(4, 2)], num_devices=16)
+
+
+def test_apply_plan_mesh_sets_layout_and_hier_execution():
+    cfg = tiny_config()
+    apply_plan(cfg, Plan(mesh_shape=(4, 2), tier="reassociating"))
+    assert cfg.mesh_shape == (4, 2)
+    assert cfg.execution == "dense"
+    cfg2 = tiny_config()
+    apply_plan(cfg2, Plan(mesh_shape=(4, 2), collective="hier",
+                          tier="reassociating"))
+    assert cfg2.execution == "hier"
+    assert cfg2.mesh_shape == (4, 2)
+
+
+def test_plan_space_offers_hier_on_multichip_runs():
+    """Multi-chip tuning (legal since ISSUE 18): the config's own mesh
+    resolution stays candidates[0], and the reassociating tier adds
+    exactly one hierarchical candidate on the config's mesh shape
+    (defaulting to the flat (n, 1) layout)."""
+    cfg = tiny_config(num_clients=8)
+    cfg.resources(autotune="reassociating", num_devices=8)
+    algo = cfg.build()
+    try:
+        space = algo._plan_space(allow_reassociating=True)
+        assert space.baseline.mesh_shape is None  # today's resolution
+        hier = [p for p in space.candidates if p.collective == "hier"]
+        assert [p.mesh_shape for p in hier] == [(8, 1)]
+    finally:
+        algo.stop()
+    cfg2 = tiny_config(num_clients=8)
+    cfg2.resources(autotune="reassociating", num_devices=8,
+                   mesh_shape=(4, 2))
+    algo2 = cfg2.build()
+    try:
+        space2 = algo2._plan_space(allow_reassociating=True)
+        assert space2.baseline.mesh_shape == (4, 2)
+        assert "|mesh=4x2" in space2.baseline.plan_id
+        hier2 = [p for p in space2.candidates if p.collective == "hier"]
+        assert [p.mesh_shape for p in hier2] == [(4, 2)]
+    finally:
+        algo2.stop()

@@ -350,7 +350,7 @@ def test_forced_packing_validation_errors():
             client_packing=2).validate()
     with pytest.raises(ValueError, match="int must be >= 2"):
         FedavgConfig().resources(client_packing=0).validate()
-    with pytest.raises(ValueError, match="single-chip"):
+    with pytest.raises(ValueError, match="num_devices>1 is an unsupported"):
         c = FedavgConfig().data(num_clients=8)
         c.num_devices = 2
         c.resources(client_packing=2).validate()

@@ -436,13 +436,15 @@ def test_validate_gates():
     check("cohort samples without replacement", backend="host", window=9)
     check("no windowed formulation", backend="host", window=4,
           execution="streamed")
-    check("single-chip", backend="host", window=4, num_devices=2)
+    check("num_devices>1 is an unsupported", backend="host", window=4,
+          num_devices=2)
     check("fault injection", backend="host", window=4,
           fault_config={"dropout_rate": 0.3})
     check("rounds_per_dispatch", backend="host", window=4,
           rounds_per_dispatch=2)
     check("nothing for a 'host' store", backend="host", window=0)
-    check("single-chip", backend="resident", window=0, num_devices=2)
+    check("num_devices>1 is an unsupported", backend="resident", window=0,
+          num_devices=2)
     check("top-k error-feedback", backend="resident", window=0,
           codec={"type": "topk", "topk_ratio": 0.1,
                  "error_feedback": True})

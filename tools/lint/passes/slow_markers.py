@@ -19,7 +19,7 @@ from typing import Iterable, List
 
 from tools.lint.core import Finding, LintContext, LintPass
 
-MESH_CALLS = {"make_mesh", "shard_federation"}
+MESH_CALLS = {"make_mesh", "shard_federation", "hier_step"}
 
 
 def _has_slow_mark(deco_list) -> bool:

@@ -10,7 +10,10 @@ two artifact classes in ISSUE 12; this CLI is the one front door:
   (blades_tpu/arrivals) are TICK-indexed on top of round-indexed, and
   the virtual arrival clock only moves forward — a ``tick`` that goes
   backwards between consecutive records means interleaved or
-  re-ordered streams and is reported as an error;
+  re-ordered streams and is reported as an error — and the pod-scale
+  row contract: ``ici_bytes`` / ``preagg_kept`` / ``mesh_shape`` are
+  stamped together by the hierarchical driver, so a partial stamp is
+  an error;
 - ``--flightrec``: ``flightrec.json`` dumps
   (:func:`blades_tpu.obs.flightrec.validate_flightrec`);
 - ``--trace``: Chrome/Perfetto span-trace exports
@@ -72,6 +75,46 @@ def _async_tick_errors(path):
                                f"{last} (line {last_line}) — the virtual "
                                "arrival clock only moves forward"))
             last, last_line = tick, lineno
+    return errors
+
+
+def _mesh_row_errors(path):
+    """Pod-scale row consistency over a metrics.jsonl stream: the three
+    hierarchical-round stamps travel together (a row with ``ici_bytes``
+    must carry ``preagg_kept`` and a ``"CxD"``-shaped ``mesh_shape``),
+    and both counters are non-negative — a partial stamp means the
+    driver and the recorder disagreed about which path ran."""
+    import json
+    import re
+
+    errors = []
+    with open(path) as f:
+        for lineno, line in enumerate(f, start=1):
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if not isinstance(rec, dict) or "ici_bytes" not in rec:
+                continue
+            missing = [k for k in ("preagg_kept", "mesh_shape")
+                       if k not in rec]
+            if missing:
+                errors.append((lineno,
+                               f"hierarchical row missing {missing}: "
+                               "ici_bytes/preagg_kept/mesh_shape are "
+                               "stamped together by the hier driver"))
+                continue
+            if rec["ici_bytes"] < 0 or rec["preagg_kept"] < 1:
+                errors.append((lineno,
+                               f"hierarchical counters out of range: "
+                               f"ici_bytes={rec['ici_bytes']}, "
+                               f"preagg_kept={rec['preagg_kept']}"))
+            if not re.fullmatch(r"\d+x\d+", str(rec["mesh_shape"])):
+                errors.append((lineno,
+                               f"mesh_shape must be 'CxD', got "
+                               f"{rec['mesh_shape']!r}"))
     return errors
 
 
@@ -145,7 +188,8 @@ def main(argv=None) -> int:
             from blades_tpu.obs.schema import validate_jsonl
 
             num, errors = validate_jsonl(path)
-            errors = list(errors) + _async_tick_errors(path)
+            errors = (list(errors) + _async_tick_errors(path)
+                      + _mesh_row_errors(path))
             rc |= _report(path, num, "record(s)", errors)
     return rc
 
