@@ -38,7 +38,12 @@ Two measured workloads, one JSON line:
    ``parallel/hier.py`` per-chip robust pre-aggregation vs the flat
    GSPMD round — stamping the trace-time ``ici_bytes`` next to the
    wall times; runs LAST on both backends because it may re-provision
-   the device count.)
+   the device count.  And env-gated ``BLADES_BENCH_GOSSIP``:
+   decentralized-vs-centralized A/B — the same protocol over ring and
+   4-regular peer graphs (``blades_tpu/topology``) vs the dense
+   single-server round — stamping the trace-time ``gossip_ici_bytes``
+   and each graph's spectral gap next to the wall times; rides the
+   same provisioning tail as MESH on both backends.)
 2. **ResNet-18 @ 768 clients** (the model BASELINE.json actually names):
    768 is the single-chip capacity limit under malicious-lane elision —
    the benign-compacted bf16 update matrix stores 576 rows = 12.9 GB
@@ -1124,6 +1129,95 @@ def _mesh_block(cpu: bool) -> dict:
     return out
 
 
+def _measure_gossip_arm(graph, *, num_clients, model, input_shape,
+                        dataset, timed_rounds, n_devices=8) -> dict:
+    """One arm of the BLADES_BENCH_GOSSIP A/B (ISSUE 19) through the
+    FULL driver: ``graph=None`` runs the centralized dense round
+    (single-server baseline), a graph name runs the decentralized
+    gossip round (``execution='gossip'``) over that peer topology —
+    per-node local training, neighborhood exchange, per-node robust
+    aggregation, doubly-stochastic mixing.  The gossip arms stamp the
+    trace-time ``gossip_ici_bytes`` and graph provenance next to the
+    wall time."""
+    from blades_tpu.algorithms import FedavgConfig
+
+    cfg = (
+        FedavgConfig()
+        .data(dataset=dataset, num_clients=num_clients, seed=0)
+        .training(global_model=model, server_lr=0.5,
+                  train_batch_size=BATCH,
+                  num_batch_per_round=LOCAL_STEPS,
+                  aggregator={"type": "Median"},
+                  input_shape=input_shape)
+        .client(lr=0.1)
+        .adversary(num_malicious_clients=num_clients // 4,
+                   adversary_config={"type": "ALIE"})
+        .evaluation(evaluation_interval=0)
+    )
+    if graph is None:
+        cfg.resources(num_devices=n_devices)
+    else:
+        cfg.resources(num_devices=n_devices, execution="gossip")
+        cfg.topology(graph=graph, k=4)
+    algo = cfg.build()
+    try:
+        row = algo.train()  # compile + settle outside the timed loop
+        t0 = time.perf_counter()
+        for _ in range(timed_rounds):
+            row = algo.train()
+        dt = time.perf_counter() - t0
+        final_loss = float(row["train_loss"])
+        assert final_loss == final_loss  # NaN guard
+        out = {
+            "rounds_per_sec": round(timed_rounds / dt, 4),
+            "round_s": round(dt / timed_rounds, 4),
+            "clients": num_clients, "model": model,
+            "batch": BATCH, "local_steps": LOCAL_STEPS,
+            "timed_rounds": timed_rounds, "aggregator": "Median",
+            "adversary": "ALIE", "n_devices": n_devices,
+            "path": "centralized" if graph is None else f"gossip_{graph}",
+            "final_loss": final_loss,
+        }
+        if graph is not None:
+            out["gossip_ici_bytes"] = row.get("gossip_ici_bytes")
+            out["topology"] = row.get("topology")
+            out["spectral_gap"] = row.get("spectral_gap")
+            out["consensus_dist"] = row.get("consensus_dist")
+        return out
+    finally:
+        algo.stop()
+
+
+def _gossip_block(cpu: bool) -> dict:
+    """BLADES_BENCH_GOSSIP satellite (ISSUE 19): decentralized-vs-
+    centralized A/B on 8 devices — the 32-client Median protocol run
+    centralized (dense single-server round), over a ring (diameter
+    n/2, cheapest wire), and over a 4-regular graph (denser mixing) —
+    riding TPU main and the cpu_fallback box (8 virtual CPU devices
+    via the dryrun provisioning recipe).  Per-round wall time and the
+    trace-time ``gossip_ici_bytes`` land per arm; the spectral gaps
+    stamp how much consensus contraction each wire budget buys."""
+    from __graft_entry__ import _provision_devices
+
+    _provision_devices(8)
+    if cpu:
+        kw = dict(num_clients=16, model="mlp", dataset="mnist",
+                  input_shape=None, timed_rounds=2)
+    else:
+        kw = dict(num_clients=32, model="cnn", dataset="cifar10",
+                  input_shape=None, timed_rounds=3)
+    central = _measure_gossip_arm(None, **kw)
+    ring = _measure_gossip_arm("ring", **kw)
+    kreg = _measure_gossip_arm("kregular", **kw)
+    out = {"centralized": central, "ring": ring, "kregular": kreg}
+    if central["rounds_per_sec"]:
+        out["ring_over_centralized"] = round(
+            ring["rounds_per_sec"] / central["rounds_per_sec"], 3)
+        out["kregular_over_centralized"] = round(
+            kreg["rounds_per_sec"] / central["rounds_per_sec"], 3)
+    return out
+
+
 def _measure_ooc_round(backend: str, *, num_clients=32, window=8,
                        num_byzantine=8, timed_rounds=3, model="cnn",
                        dataset="cifar10", adversary="ALIE",
@@ -1390,6 +1484,15 @@ def _cpu_fallback(probe_err: str) -> None:
             out["control"] = _control_block(cpu=True)
         except Exception as e:
             out["control"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+    if os.environ.get("BLADES_BENCH_GOSSIP", "1") == "1":
+        try:
+            # Decentralized gossip federation (ISSUE 19): ring/kregular
+            # vs centralized A/B on 8 virtual CPU devices.  Runs in the
+            # provisioning tail with mesh: _provision_devices may clear
+            # backends, invalidating arrays earlier blocks hold.
+            out["gossip"] = _gossip_block(cpu=True)
+        except Exception as e:
+            out["gossip"] = {"error": f"{type(e).__name__}: {e}"[:300]}
     if os.environ.get("BLADES_BENCH_MESH", "1") == "1":
         try:
             # Pod-scale federation (ISSUE 18): hierarchical-vs-flat
@@ -1549,6 +1652,18 @@ def main() -> None:
             out["control"] = _control_block(cpu=False)
         except Exception as e:
             out["control"] = {"error": f"{type(e).__name__}: {e}"[:500]}
+
+    if os.environ.get("BLADES_BENCH_GOSSIP", "1") == "1":
+        try:
+            # Decentralized gossip federation (ISSUE 19): the 32-client
+            # Median protocol centralized vs over ring / 4-regular peer
+            # graphs, gossip_ici_bytes stamped from the trace-time
+            # recorder.  Runs in the provisioning tail with mesh:
+            # _provision_devices may clear backends when the box has
+            # fewer than 8 devices.
+            out["gossip"] = _gossip_block(cpu=False)
+        except Exception as e:
+            out["gossip"] = {"error": f"{type(e).__name__}: {e}"[:500]}
 
     if os.environ.get("BLADES_BENCH_MESH", "1") == "1":
         try:

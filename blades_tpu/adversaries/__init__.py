@@ -25,6 +25,9 @@ from blades_tpu.adversaries.campaigns import (  # noqa: F401
     DiurnalALIECampaign,
     LazyRampCampaign,
 )
+from blades_tpu.adversaries.topology_attacks import (  # noqa: F401
+    TopologyAttackAdversary,
+)
 from blades_tpu.adversaries.training_attacks import (  # noqa: F401
     LabelFlipAdversary,
     SignFlipAdversary,
@@ -61,6 +64,10 @@ ADVERSARIES = {
     # the arrival tick clock).
     "DiurnalALIE": DiurnalALIECampaign,
     "LazyRamp": LazyRampCampaign,
+    # Topology-scoped poisoning (gossip path only): wraps any forging
+    # attack, restricting forged rows to the attacker's out-edges or a
+    # single eclipse-targeted receiver (blades_tpu/topology).
+    "TopologyAttack": TopologyAttackAdversary,
 }
 
 _ALIASES = {cls.__name__: cls for cls in ADVERSARIES.values()}

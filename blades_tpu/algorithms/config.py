@@ -266,6 +266,14 @@ class FedavgConfig:
         # hierarchical round is then bit-identical to single-chip dense.
         self.preagg: str = "bucket"
         self.bucket_size: int = 1
+        # Decentralized gossip federation (execution="gossip",
+        # blades_tpu/topology): the peer-graph spec — a dict for
+        # TopologyConfig (graph/k/p/graph_seed/mixing; num_nodes is
+        # pinned to num_clients) or a bare graph name.  None with
+        # execution="gossip" runs the TopologyConfig defaults (ring);
+        # setting it WITHOUT execution="gossip" is a validate()-time
+        # error.  Set via .topology(...).
+        self.topology_config: Optional[Dict] = None
         self._frozen = False
         # Packing decision from the last get_fed_round() resolution
         # (requested/pack_factor/packed_lanes/fallback) — surfaced in
@@ -453,6 +461,23 @@ class FedavgConfig:
             spec = {"enabled": True}  # bare .control() arms the defaults
         return self._set(control_config=spec)
 
+    def topology(self, *, graph=None, k=None, p=None, graph_seed=None,
+                 mixing=None):
+        """Peer-graph spec for ``execution="gossip"``
+        (:class:`blades_tpu.topology.TopologyConfig`): the named graph
+        family (``ring`` | ``torus`` | ``kregular`` | ``erdos`` |
+        ``complete``), its one size knob (``k`` for kregular, ``p`` for
+        erdos), the Erdős–Rényi draw seed and the doubly-stochastic
+        mixing scheme (``metropolis`` | ``uniform``).  Merges into
+        ``topology_config`` (the ``.arrivals()`` pattern); see the
+        README "Decentralized gossip federation" section."""
+        spec = dict(self.topology_config or {})
+        for key, v in (("graph", graph), ("k", k), ("p", p),
+                       ("graph_seed", graph_seed), ("mixing", mixing)):
+            if v is not None:
+                spec[key] = v
+        return self._set(topology_config=spec or None)
+
     def communication(self, *, codec=None, agg_domain=None):
         """Compressed-update codec on the client->server uplink
         (``codec=`` a dict for :class:`blades_tpu.comm.CodecConfig`,
@@ -553,11 +578,76 @@ class FedavgConfig:
             self.num_classes = _NUM_CLASSES[name]
             self._inferred.add("num_classes")
         if self.execution not in ("auto", "dense", "streamed", "dsharded",
-                                  "async", "hier"):
+                                  "async", "hier", "gossip"):
             raise ValueError(
-                "execution must be auto|dense|streamed|dsharded|async|hier, "
-                f"got {self.execution!r}"
+                "execution must be auto|dense|streamed|dsharded|async|hier"
+                f"|gossip, got {self.execution!r}"
             )
+        if self.topology_config and self.execution != "gossip":
+            raise ValueError(
+                "topology_config is set but execution="
+                f"{self.execution!r}: the peer-graph spec only drives the "
+                "decentralized gossip path — set "
+                ".resources(execution='gossip') or drop .topology(...)"
+            )
+        if self.execution == "gossip":
+            # Build the topology now so a bad (graph, knob) pair fails at
+            # validate() time (TopologyConfig.__post_init__ builds the
+            # adjacency) — the faults/codec fail-fast discipline.
+            self.get_topology()
+            for knob, why, flip in (
+                (self.codec_config, "update codecs",
+                 ".communication(codec=None)"),
+                (self.agg_domain != "f32", "wire-domain aggregation",
+                 ".communication(agg_domain='f32')"),
+                (self.client_packing not in ("off", None),
+                 "client lane-packing",
+                 ".resources(client_packing='off')"),
+                (self.state_window is not None,
+                 "the participation-window store",
+                 ".resources(window=None)"),
+                (self.state_store != "resident",
+                 "out-of-core client state",
+                 ".resources(state_store='resident')"),
+                (self.forensics, "defense forensics",
+                 ".observability(forensics=False)"),
+                (self.ledger_backend, "the client ledger",
+                 ".observability(ledger=False)"),
+                (self.control_config, "the control plane",
+                 "drop .control()"),
+                (int(self.rounds_per_dispatch or 1) != 1,
+                 "rounds_per_dispatch > 1", "rounds_per_dispatch=1"),
+                (self.chained_dispatch, "chained_dispatch",
+                 "chained_dispatch=False"),
+                (self.autotune_mode, "the execution autotuner",
+                 ".resources(autotune='off')"),
+                (self.mesh_shape is not None, "2-D mesh_shape",
+                 ".resources(mesh_shape=None)"),
+            ):
+                if knob:
+                    raise ValueError(
+                        f"execution='gossip' × {why} is an unsupported "
+                        "pair: the decentralized round has no central "
+                        "server matrix for that stage to rewrite — set "
+                        f"{flip}, or use a server execution path"
+                    )
+            injector = self.get_fault_injector()
+            if injector is not None:
+                if injector.needs_stale_buffer:
+                    raise ValueError(
+                        "execution='gossip' × straggler faults is an "
+                        "unsupported pair: the stale ring buffer is a "
+                        "server-path process — gossip faults are EDGE "
+                        "dropout (dropout_rate/dropout_schedule); set "
+                        "num_stragglers=0"
+                    )
+                if injector.corrupt_rate > 0.0:
+                    raise ValueError(
+                        "execution='gossip' × corruption faults is an "
+                        "unsupported pair: lane corruption models "
+                        "server-bound transfers — gossip faults are EDGE "
+                        "dropout; set corrupt_rate=0"
+                    )
         if self.async_config and self.execution != "async":
             raise ValueError(
                 "async_config is set but execution="
@@ -720,8 +810,10 @@ class FedavgConfig:
                 # replicated before injection, so the chaos layer
                 # composes there — as long as the pre-aggregation keeps
                 # matrix height (kept == n) and no straggler ring is
-                # configured (the stale buffer is sized per LANE).
-                if self.execution != "hier":
+                # configured (the stale buffer is sized per LANE).  The
+                # gossip path composes too, with its OWN edge-dropout
+                # realization (gated above, not injector.inject).
+                if self.execution not in ("hier", "gossip"):
                     raise ValueError(
                         "fault injection × num_devices>1 is an "
                         "unsupported pair on the flat mesh paths: the "
@@ -729,23 +821,24 @@ class FedavgConfig:
                         "the lane axis — set .resources(num_devices=None) "
                         "or .resources(execution='hier'), or drop faults"
                     )
-                injector = self.get_fault_injector()
-                if injector is not None and injector.needs_stale_buffer:
-                    raise ValueError(
-                        "execution='hier' × straggler faults is an "
-                        "unsupported pair: the stale ring buffer is "
-                        "sized per lane and has no hierarchical "
-                        "formulation — set num_stragglers=0, or run "
-                        "single-chip"
-                    )
-                if self.preagg == "bucket" and self.bucket_size != 1:
-                    raise ValueError(
-                        "execution='hier' × fault injection needs an "
-                        "identity-height pre-aggregation (bucketing with "
-                        f"bucket_size={self.bucket_size} shrinks the "
-                        "matrix) — set .resources(bucket_size=1) or "
-                        "preagg='nnm', or drop faults"
-                    )
+                if self.execution == "hier":
+                    injector = self.get_fault_injector()
+                    if injector is not None and injector.needs_stale_buffer:
+                        raise ValueError(
+                            "execution='hier' × straggler faults is an "
+                            "unsupported pair: the stale ring buffer is "
+                            "sized per lane and has no hierarchical "
+                            "formulation — set num_stragglers=0, or run "
+                            "single-chip"
+                        )
+                    if self.preagg == "bucket" and self.bucket_size != 1:
+                        raise ValueError(
+                            "execution='hier' × fault injection needs an "
+                            "identity-height pre-aggregation (bucketing "
+                            f"with bucket_size={self.bucket_size} shrinks "
+                            "the matrix) — set .resources(bucket_size=1) "
+                            "or preagg='nnm', or drop faults"
+                        )
         if self.codec_config:
             # Build the codec now so a bad spec fails at validate() time
             # (CodecConfig.__post_init__ range-checks every knob).
@@ -940,6 +1033,16 @@ class FedavgConfig:
                     "campaign attack scheduled over virtual arrival time; "
                     f"execution={self.execution!r} has no tick clock — set "
                     ".resources(execution='async')"
+                )
+            # Topology-scoped attacks poison per-RECEIVER over the peer
+            # graph — only the gossip round has receivers to scope.
+            if getattr(adv, "topology_scoped", False) \
+                    and self.execution != "gossip":
+                raise ValueError(
+                    f"adversary {self.adversary_config.get('type')!r} is "
+                    "topology-scoped (per-receiver poisoning over the "
+                    f"peer graph); execution={self.execution!r} has no "
+                    "peer graph — set .resources(execution='gossip')"
                 )
         # Closed-loop control plane: build the policy now (unknown keys
         # / bad bounds fail here), then gate the structurally impossible
@@ -1255,6 +1358,18 @@ class FedavgConfig:
         from blades_tpu.control import ControlPolicy
 
         return ControlPolicy.from_config(self.control_config)
+
+    def get_topology(self):
+        """Build the gossip path's
+        :class:`~blades_tpu.topology.TopologyConfig` from
+        ``topology_config`` (None unless ``execution="gossip"``), with
+        ``num_nodes`` pinned to ``num_clients`` — on the gossip path
+        every client IS a node."""
+        if self.execution != "gossip":
+            return None
+        from blades_tpu.topology import get_topology
+
+        return get_topology(self.topology_config, int(self.num_clients))
 
     def get_codec(self):
         """Build the comm subsystem's

@@ -144,6 +144,8 @@ class Fedavg:
         self._cache_wrappers = []  # CachedFunctions feeding the obs counters
         self._async = None        # AsyncEngine under execution="async"
         self._hier_recorder = None  # PassRecorder under execution="hier"
+        self._gossip_recorder = None  # PassRecorder under execution="gossip"
+        self._topology = None  # NeighborTables under execution="gossip"
         self.mesh = None
         # Client permutation applied to the stacked arrays (d-sharded
         # elision layout); None = natural order.  Checkpoints record it
@@ -184,6 +186,30 @@ class Fedavg:
             self._evaluate = jax.jit(self.fed_round.evaluate)
         elif self._windowed:
             self._setup_windowed_pipeline()
+        elif cfg.execution == "gossip":
+            # Decentralized gossip federation (blades_tpu/topology): every
+            # node keeps its own params replica; one round = local train →
+            # neighborhood exchange → per-node robust aggregation → mixing.
+            # Engages on any device count (a 1-chip mesh still runs the
+            # per-node program; the all_gathers just carry zero wire cost).
+            from blades_tpu.parallel import make_mesh
+            from blades_tpu.topology import (gossip_evaluate,
+                                             gossip_federation, gossip_step)
+
+            self.mesh = make_mesh(num_devices=cfg.num_devices)
+            self._topology = cfg.get_topology()
+            # Malicious mask stays REPLICATED and UNPADDED, like hier:
+            # gossip_step pads and slices it inside the traced program
+            # (dense-mirroring RNG needs the true node count).
+            self.state, self._train_arrays = gossip_federation(
+                self.mesh, self.state, self._train_arrays
+            )
+            self._step, self._gossip_recorder = gossip_step(
+                self.fed_round, self.mesh, self._topology
+            )
+            # Evaluation reads the node-0 replica head; test arrays stay
+            # in their default (replicated) placement.
+            self._evaluate = gossip_evaluate(self.fed_round)
         elif cfg.num_devices and cfg.num_devices > 1:
             from blades_tpu.parallel import make_mesh, shard_federation, sharded_step
             from blades_tpu.parallel.sharded import sharded_evaluate, sharded_multi_step
@@ -1363,6 +1389,20 @@ class Fedavg:
             ms = getattr(self.config, "mesh_shape", None) or \
                 (int(self.config.num_devices or 1), 1)
             row["mesh_shape"] = f"{int(ms[0])}x{int(ms[1])}"
+        if "gossip_ici_bytes" in metrics:
+            # Decentralized gossip accounting (blades_tpu/topology): the
+            # neighborhood-exchange wire bytes counted at trace time, the
+            # consensus diameter over round-input replicas, and the graph
+            # provenance (static per run, stamped host-side so every row
+            # names the topology it gossiped over).
+            row["gossip_ici_bytes"] = int(metrics["gossip_ici_bytes"])
+            row["num_partitioned_nodes"] = int(
+                metrics["num_partitioned_nodes"])
+            row["consensus_dist"] = float(metrics["consensus_dist"])
+            prov = self._topology.provenance()
+            row["topology"] = str(prov["topology"])
+            row["graph_seed"] = int(prov["graph_seed"])
+            row["spectral_gap"] = float(prov["spectral_gap"])
         if "elided_lanes" in metrics:
             # Malicious-lane training elision engaged (streamed/d-sharded
             # paths): surfaces the optimistic num_unhealthy basis — an
@@ -1890,9 +1930,17 @@ class Fedavg:
                     f"{self._iteration} (the action journal before it "
                     "is not recoverable)", RuntimeWarning, stacklevel=2)
         if self.mesh is not None:
-            from blades_tpu.parallel import shard_federation
+            if self.config.execution == "gossip":
+                # The checkpoint carries the (n_pad, ...) per-node params
+                # stack verbatim; re-lay it on the gossip mesh without
+                # re-broadcasting (kill-and-resume bit-identity).
+                from blades_tpu.topology import reshard_gossip_state
 
-            state, _ = shard_federation(self.mesh, state, ())
+                state = reshard_gossip_state(self.mesh, state)
+            else:
+                from blades_tpu.parallel import shard_federation
+
+                state, _ = shard_federation(self.mesh, state, ())
         if self._ledger is not None:
             ledger_dir = p.parent / "ledger"
             if (ledger_dir / "manifest.json").exists():

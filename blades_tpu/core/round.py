@@ -12,6 +12,13 @@ Weight "sync" is a broadcast (``in_axes=None``); the update "gather" is the
 stacked ``(n, d)`` matrix already on device.  Under ``shard_map`` (see
 :mod:`blades_tpu.parallel`) the client axis shards over the mesh and the
 gather becomes an ICI collective.
+
+The decentralized gossip path (:mod:`blades_tpu.topology`) reuses this
+same round decomposition with NO central server: each node runs
+``task.local_round`` from its OWN params replica, then the per-node
+neighborhood matrix feeds ``server.aggregator`` with per-node geometry —
+the ``FedRound`` fields below (task, server, adversary, faults, health)
+are the single source of round semantics for all five execution paths.
 """
 
 from __future__ import annotations

@@ -62,6 +62,12 @@ REPLAY_FIELDS = (
     # trajectory reproduces them bit-for-bit.
     "cycle_ticks", "arrivals_quarantined", "control_actions_total",
     "quarantine_size",
+    # Decentralized gossip round (blades_tpu/topology): wire accounting
+    # and graph provenance are trace-time / config statics; the fault
+    # realization and consensus diameter are pure in (fault_seed, round)
+    # and the replica stack — all replay bit-for-bit.
+    "gossip_ici_bytes", "num_partitioned_nodes", "consensus_dist",
+    "spectral_gap", "graph_seed",
 )
 
 #: Wall-clock / run-shape fields dropped from digests — they vary run to

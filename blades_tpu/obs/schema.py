@@ -164,6 +164,19 @@ ROUND_RECORD_FIELDS: Dict[str, Tuple[tuple, bool]] = {
     "ici_bytes": ((int,), False),
     "preagg_kept": ((int,), False),
     "mesh_shape": ((str,), False),
+    # Decentralized gossip round (blades_tpu/topology): graph provenance
+    # (family name, random-family seed, spectral gap of the mixing
+    # matrix — static per run), the neighborhood-exchange ICI bytes
+    # (trace-time static, reconciled both ways against
+    # parallel/comm_model.gossip_round_volumes), the consensus diameter
+    # over round-input replicas, and how many nodes fell below their
+    # aggregator's breakdown bound after edge dropout this round.
+    "topology": ((str,), False),
+    "graph_seed": ((int,), False),
+    "spectral_gap": (_NUM, False),
+    "gossip_ici_bytes": ((int,), False),
+    "num_partitioned_nodes": ((int,), False),
+    "consensus_dist": (_NUM, False),
     # perf layer (blades_tpu/perf): AOT executable-cache traffic,
     # cumulative per trial — a trial whose round program was served from
     # the cache reports misses == 0 from its first row.

@@ -32,6 +32,13 @@ program:
   ``m`` representatives (bucketing / nearest-neighbor mixing) before ONE
   ring all-gather feeds the global defense — dense-mirroring RNG, so
   ``bucket_size=1`` is bit-identical to the single-chip round.
+- :func:`blades_tpu.topology.gossip_step` — the FIFTH path and the first
+  with no server at all: per-node params replicas sharded over the 1-D
+  clients mesh, peer-graph neighborhood exchange + per-node robust
+  aggregation + doubly-stochastic mixing (see :mod:`blades_tpu.topology`;
+  it lives outside this package because the graph, not the mesh, is its
+  organizing geometry) — same dense-mirroring RNG, so complete-graph +
+  Mean is bit-identical to the centralized round.
 
 Orthogonally, :mod:`blades_tpu.parallel.packed` raises arithmetic
 intensity PER LANE on the dense path: client lane-packing folds P narrow

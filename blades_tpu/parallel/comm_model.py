@@ -149,6 +149,16 @@ def _adversary_volumes(adversary: Optional[str], n: int,
     if adversary in (None, "ALIE", "IPM", "Adaptive", "Noise", "SignFlip",
                      "LabelFlip", "Lazy", "DiurnalALIE", "LazyRamp"):
         return []
+    if adversary == "TopologyAttack":
+        # Topology-scoped wrapper (adversaries/topology_attacks.py): its
+        # own mechanism is a per-receiver mask applied elementwise to the
+        # already-gathered replica stack inside the gossip round —
+        # collective-free on any sharded layout.  The forged CONTENT
+        # comes from the wrapped base adversary, whose geometry is
+        # modelled under its own registered name; validate() pins
+        # TopologyAttack to execution='gossip', where the exchange
+        # itself is accounted by :func:`gossip_round_volumes`.
+        return []
     if adversary == "MinMax":
         # pairwise dists among benign rows + one distance-norm psum per
         # bisection step (update_attacks.py:145-160,
@@ -273,6 +283,47 @@ def hier_round_volumes(
     vols.append((CollectiveVolume("losses_gather", "all_gather",
                                   n_pad * f4), c))
     return vols
+
+
+def gossip_round_volumes(
+    n: int, d: int, mesh_shape, *, faults: bool = False,
+) -> List[tuple]:
+    """Every collective one gossip round issues, as
+    ``(CollectiveVolume, ring_size)`` pairs.
+
+    The analytic twin of :func:`blades_tpu.topology.gossip.gossip_step`'s
+    trace-time recorder events, computed with its OWN arithmetic from
+    the round geometry (1-D clients mesh, node padding) —
+    ``tests/test_topology.py`` reconciles the two inventories in both
+    directions, event by event.  The gossip round's exchange volume is
+    topology-INDEPENDENT on the 1-D mesh: the neighborhood selection is
+    a local gather from the all-gathered update/params matrices, so the
+    wire cost is two ``(n_pad, d)`` all-gathers plus two ``(n_pad,)``
+    scalar gathers (losses, aggregate norms), and — with an edge-fault
+    process armed — one scalar psum for the partition count.
+    """
+    c = int(mesh_shape[0])
+    f4 = 4
+    n_local = -(-n // c)
+    n_pad = c * n_local
+    vols = [
+        (CollectiveVolume("updates_gather", "all_gather",
+                          n_pad * d * f4), c),
+        (CollectiveVolume("params_gather", "all_gather",
+                          n_pad * d * f4), c),
+        (CollectiveVolume("losses_gather", "all_gather", n_pad * f4), c),
+        (CollectiveVolume("aggnorm_gather", "all_gather", n_pad * f4), c),
+    ]
+    if faults:
+        vols.append((CollectiveVolume("partitioned_psum", "psum", f4), c))
+    return vols
+
+
+def gossip_wire_bytes(volumes: List[tuple]) -> int:
+    """Per-chip ring wire total for :func:`gossip_round_volumes` pairs —
+    the same exact integer ring arithmetic as :func:`hier_wire_bytes`,
+    so reconciliation against the recorder is equality."""
+    return hier_wire_bytes(volumes)
 
 
 def hier_wire_bytes(volumes: List[tuple]) -> int:

@@ -1,0 +1,22 @@
+"""Decentralized gossip federation: peer graphs + serverless rounds.
+
+The subsystem behind ``execution="gossip"`` (ROADMAP item 4, the
+decentralized half of BLADE-FL arXiv:2012.02044): static peer graphs
+with doubly-stochastic mixing (:mod:`blades_tpu.topology.graph`) and the
+per-node robust gossip round (:mod:`blades_tpu.topology.gossip`).
+"""
+
+from blades_tpu.topology.graph import (  # noqa: F401
+    GRAPHS,
+    MIXINGS,
+    NeighborTables,
+    TopologyConfig,
+    get_topology,
+)
+from blades_tpu.topology.gossip import (  # noqa: F401
+    EDGE_FOLD,
+    gossip_evaluate,
+    gossip_federation,
+    gossip_step,
+    reshard_gossip_state,
+)

@@ -160,7 +160,7 @@ def main(argv=None) -> int:
                        "(default; see README \"Client packing\")")
     p_run.add_argument("--execution", default=None,
                        choices=("auto", "dense", "streamed", "dsharded",
-                                "async", "hier"),
+                                "async", "hier", "gossip"),
                        help="execution path override; 'async' runs the "
                        "buffered-async mode (blades_tpu/arrivals): a "
                        "deterministic Poisson arrival process, clients "
@@ -169,7 +169,9 @@ def main(argv=None) -> int:
                        "buffered arrivals (see README \"Async buffered "
                        "execution\"); 'hier' runs the pod-scale "
                        "hierarchical round (see README \"Pod-scale "
-                       "federation\")")
+                       "federation\"); 'gossip' runs the decentralized "
+                       "per-node round over a peer graph (see README "
+                       "\"Decentralized gossip federation\")")
     p_run.add_argument("--mesh-shape", default=None, metavar="CxD",
                        help="2-D (clients, d) device mesh for multi-chip "
                        "runs, e.g. '4x2'; must tile num_devices exactly "
@@ -197,6 +199,22 @@ def main(argv=None) -> int:
                        "EF-residual rows live; 'host'/'disk' require "
                        "--window (see README \"Out-of-core client "
                        "state\")")
+    p_run.add_argument("--topology", default=None,
+                       choices=("ring", "torus", "kregular", "erdos",
+                                "complete"),
+                       help="peer graph family for --execution gossip "
+                       "(blades_tpu/topology); 'complete' with Mean is "
+                       "bit-identical to the centralized dense round")
+    p_run.add_argument("--mixing", default=None,
+                       choices=("metropolis", "uniform"),
+                       help="doubly-stochastic mixing scheme for "
+                       "--execution gossip; Metropolis–Hastings weights "
+                       "by default")
+    p_run.add_argument("--graph-seed", type=int, default=None,
+                       metavar="S",
+                       help="seed for the random graph families "
+                       "(--topology erdos); part of the run provenance "
+                       "so two processes build the same graph")
     p_run.add_argument("--window", type=int, default=None, metavar="W",
                        help="participation window: clients sampled into "
                        "each round's cohort (0 = stateless clients, the "
@@ -283,6 +301,16 @@ def main(argv=None) -> int:
             run_config["preagg"] = args.preagg
         if args.bucket_size is not None:
             run_config["bucket_size"] = args.bucket_size
+        if (args.topology is not None or args.mixing is not None
+                or args.graph_seed is not None):
+            topo = dict(run_config.get("topology_config") or {})
+            if args.topology is not None:
+                topo["graph"] = args.topology
+            if args.mixing is not None:
+                topo["mixing"] = args.mixing
+            if args.graph_seed is not None:
+                topo["graph_seed"] = args.graph_seed
+            run_config["topology_config"] = topo
         if args.arrivals_json is not None:
             run_config["async_config"] = json.loads(args.arrivals_json)
         if args.state_store is not None:

@@ -479,6 +479,8 @@ _TRACE_ROW_ATTRS = (
     "elided_lanes", "compile_cache_hits", "compile_cache_misses",
     "dequant_rows", "num_participating", "num_dropped", "num_straggled",
     "ici_bytes", "preagg_kept", "mesh_shape",
+    "gossip_ici_bytes", "num_partitioned_nodes", "topology",
+    "spectral_gap",
 )
 
 
@@ -667,6 +669,17 @@ def _mesh_summary(row: Dict) -> Optional[Dict]:
     mesh = {k: row[k] for k in ("mesh_shape", "ici_bytes", "preagg_kept")
             if k in row}
     return mesh if "ici_bytes" in mesh else None
+
+
+def _gossip_summary(row: Dict) -> Optional[Dict]:
+    """The decentralized-round provenance slice for trial summaries
+    (graph stamps are static per run; gossip_ici_bytes is static under a
+    fixed config, so the last row stands for the trial)."""
+    g = {k: row[k] for k in ("topology", "graph_seed", "spectral_gap",
+                             "gossip_ici_bytes", "num_partitioned_nodes",
+                             "consensus_dist")
+         if k in row}
+    return g if "gossip_ici_bytes" in g else None
 
 
 def _arrivals_summary(row: Dict) -> Optional[Dict]:
@@ -1342,6 +1355,11 @@ def run_experiments(
                 # Pod-scale hierarchical-round digest (parallel/hier.py),
                 # mirrored from the final row like the comm block.
                 summary["mesh"] = mesh
+            gossip = _gossip_summary(last_row)
+            if gossip:
+                # Decentralized-round digest (blades_tpu/topology),
+                # mirrored from the final row like the mesh block.
+                summary["gossip"] = gossip
             packing = getattr(algo, "packing_summary", None)
             if packing:
                 # Lane-packing decision (parallel/packed.py): present

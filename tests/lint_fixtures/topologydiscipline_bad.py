@@ -1,0 +1,26 @@
+"""Seeded topology-discipline violations: a file that builds topology
+neighbor tables AND spells raw cross-device collectives — each one an
+UNCOUNTED neighborhood exchange, so the round's ``gossip_ici_bytes``
+stamp stops reconciling against ``comm_model.gossip_round_volumes``
+(bare ``lax.`` call, fully dotted ``jax.lax.`` call, and a psum)."""
+
+import jax
+from jax import lax
+
+from blades_tpu.topology import TopologyConfig
+from blades_tpu.topology.graph import get_topology
+
+
+def uncounted_exchange(theta, axis):
+    topo = TopologyConfig(graph="ring", num_nodes=8)
+    tables = topo.neighbor_tables()
+    everyone = lax.all_gather(theta, axis, tiled=True)     # BAD: uncounted
+    total = jax.lax.psum(theta, axis)                      # BAD: uncounted
+    return everyone[tables.nbr_idx], total
+
+
+def resolve_and_mix(spec, theta, axis):
+    topo = get_topology(spec, 8)
+    shifted = jax.lax.ppermute(                            # BAD: uncounted
+        theta, axis, [(i, (i + 1) % 8) for i in range(8)])
+    return topo.mixing_matrix(), shifted

@@ -1,0 +1,28 @@
+"""Clean twin of topologydiscipline_bad.py: table-building without raw
+collectives — every value here is host-side graph math, and the actual
+exchange goes through the gossip program's counted entry points.  (The
+other no-finding direction — raw collectives in files that never touch
+the topology tables, e.g. parallel/hier.py's counted gathers — is
+covered by the repo-tree scan staying at zero findings.)"""
+
+import numpy as np
+
+from blades_tpu.topology import TopologyConfig, get_topology
+
+
+def build_tables(spec):
+    # Host-side graph math only — no wire traffic to count.
+    topo = get_topology(spec, 8)
+    tables = topo.neighbor_tables()
+    return tables, topo.mixing_matrix(), topo.spectral_gap
+
+
+def provenance_row(graph="ring"):
+    topo = TopologyConfig(graph=graph, num_nodes=8)
+    prov = topo.provenance()
+    return {k: prov[k] for k in ("topology", "graph_seed", "spectral_gap")}
+
+
+def degree_stats(spec):
+    a = get_topology(spec, 8).adjacency()
+    return int(np.max(a.sum(axis=1)))

@@ -43,6 +43,9 @@ from tools.lint.passes.purity import PurityPass  # noqa: E402
 from tools.lint.passes.schema_drift import SchemaDriftPass  # noqa: E402
 from tools.lint.passes.slow_markers import audit_path  # noqa: E402
 from tools.lint.passes.static_args import StaticArgsPass  # noqa: E402
+from tools.lint.passes.topology_discipline import (  # noqa: E402
+    TopologyDisciplinePass,
+)
 from tools.lint.passes.trace_discipline import TraceDisciplinePass  # noqa: E402
 from tools.lint.core import LintContext  # noqa: E402
 
@@ -243,6 +246,32 @@ def test_pass_discipline_fixtures():
     # + aggregate_wire) produce nothing.
     assert run_fixture([PassDisciplinePass()],
                        "passdiscipline_good.py") == []
+
+
+def test_topology_discipline_fixtures():
+    """ISSUE 19 fixture pair: a file that builds topology neighbor
+    tables and spells a raw cross-device collective is an UNCOUNTED
+    neighborhood exchange (gossip_ici_bytes stops reconciling); the
+    host-side-graph-math twin stays silent."""
+    bad = errors_of(run_fixture([TopologyDisciplinePass()],
+                                "topologydiscipline_bad.py"),
+                    "topology-discipline")
+    msgs = "\n".join(f.message for f in bad)
+    assert "lax.all_gather()" in msgs
+    assert "jax.lax.psum()" in msgs
+    assert "jax.lax.ppermute()" in msgs
+    assert len(bad) == 3
+    assert run_fixture([TopologyDisciplinePass()],
+                       "topologydiscipline_good.py") == []
+
+
+def test_topology_discipline_repo_tree_clean():
+    """The real tree is clean: gossip.py's counted gathers are exempt by
+    construction (the one sanctioned module), and collective-using files
+    that never build tables (parallel/hier.py) must not false-positive."""
+    findings = errors_of(run_passes(REPO, [TopologyDisciplinePass()]),
+                         "topology-discipline")
+    assert findings == [], "\n".join(f.render() for f in findings)
 
 
 def test_trace_discipline_fixtures():

@@ -16,6 +16,7 @@ from tools.lint.passes.purity import PurityPass
 from tools.lint.passes.schema_drift import SchemaDriftPass
 from tools.lint.passes.slow_markers import SlowMarkersPass
 from tools.lint.passes.static_args import StaticArgsPass
+from tools.lint.passes.topology_discipline import TopologyDisciplinePass
 from tools.lint.passes.trace_discipline import TraceDisciplinePass
 
 ALL_PASSES = (
@@ -26,6 +27,7 @@ ALL_PASSES = (
     StaticArgsPass(),
     SchemaDriftPass(),
     PassDisciplinePass(),
+    TopologyDisciplinePass(),
     TraceDisciplinePass(),
     SlowMarkersPass(),
     ArtifactStampsPass(),

@@ -78,6 +78,11 @@ DEVICE_SIDE = (
     "blades_tpu/parallel/sharded.py",
     "blades_tpu/parallel/dsharded.py",
     "blades_tpu/parallel/packed.py",
+    # Decentralized gossip round (ISSUE 19): the per-node round program
+    # traces into shard_map — a stray sync there stalls every node's
+    # dispatch.  graph.py is deliberately NOT here: it is host-side
+    # numpy by design (tables are built once at setup).
+    "blades_tpu/topology/gossip.py",
 )
 
 _SYNC_CALLS = {"jax.device_get", "jax.block_until_ready",

@@ -1,0 +1,97 @@
+"""topology-discipline: neighborhood exchange stays behind the counted
+gossip program.
+
+The decentralized round (``blades_tpu/topology/gossip.py``) moves every
+per-node replica exchange through PassRecorder-counted collectives, so
+the ``gossip_ici_bytes`` stamp reconciles event-by-event against the
+analytic comm model (``parallel/comm_model.gossip_round_volumes``) —
+the pod-scale ``ici_bytes`` contract, extended to peer graphs.  A file
+that builds topology neighbor tables AND spells a raw cross-device
+collective re-introduces an UNCOUNTED exchange: the wire bytes the row
+reports stop covering the bytes the round actually moved, which is the
+exact drift the reconciliation tests pin.  Enforced statically like
+streamed-pass-discipline.
+
+Detection is import-based, so collectives in modules that never touch
+the topology tables (``parallel/hier.py``'s counted gathers, the mesh
+helpers) never false-positive: a call is flagged only in a file that
+also imports table-building machinery from ``blades_tpu.topology``
+(``TopologyConfig`` / ``NeighborTables`` / ``get_topology``), outside
+the gossip module itself.  Deliberate reference-path uses carry the
+unified pragma (``# blades-lint: disable=topology-discipline — <why>``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from tools.lint import astutil
+from tools.lint.core import Finding, LintContext, LintPass
+
+#: The gossip module — the only place neighborhood-exchange collectives
+#: may be spelled against the topology tables (every one counted).
+GOSSIP_MODULE = "blades_tpu/topology/gossip.py"
+
+_TOPOLOGY_MODULES = frozenset({
+    "blades_tpu.topology",
+    "blades_tpu.topology.graph",
+})
+#: Importing any of these marks the file as table-building.
+_TABLE_NAMES = frozenset({
+    "TopologyConfig", "NeighborTables", "get_topology", "neighbor_tables",
+})
+
+#: Raw cross-device exchange primitives (each an uncounted wire move
+#: when spelled outside the gossip program's recorder).
+_COLLECTIVES = frozenset({
+    "jax.lax.all_gather", "lax.all_gather",
+    "jax.lax.psum", "lax.psum",
+    "jax.lax.psum_scatter", "lax.psum_scatter",
+    "jax.lax.ppermute", "lax.ppermute",
+    "jax.lax.all_to_all", "lax.all_to_all",
+})
+
+_HINT = ("route the exchange through topology/gossip.py's counted "
+         "gathers (PassRecorder.count_ici) so gossip_ici_bytes keeps "
+         "reconciling against comm_model.gossip_round_volumes, or "
+         "pragma the line if the collective is deliberately outside "
+         "the gossip wire accounting")
+
+
+class TopologyDisciplinePass(LintPass):
+    name = "topology-discipline"
+    doc = ("raw cross-device collectives in files that build topology "
+           "neighbor tables, outside the counted gossip program")
+
+    def run(self, ctx: LintContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for src in ctx.files:
+            if src.rel == GOSSIP_MODULE or src.tree is None:
+                continue
+            if not self._builds_tables(src.tree):
+                continue
+            for call in astutil.walk_calls(src.tree):
+                cn = astutil.call_name(call)
+                if cn in _COLLECTIVES:
+                    findings.append(Finding(
+                        self.name, src.rel, call.lineno,
+                        f"raw collective {cn}() in a file that builds "
+                        "topology neighbor tables — an uncounted "
+                        "neighborhood exchange outside the gossip "
+                        "program", fix_hint=_HINT))
+        return findings
+
+    @staticmethod
+    def _builds_tables(tree: ast.Module) -> bool:
+        """Does this file import table-building machinery from the
+        topology package (including ``import ... as`` renames)?"""
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom):
+                if node.module in _TOPOLOGY_MODULES:
+                    if any(a.name in _TABLE_NAMES for a in node.names):
+                        return True
+            elif isinstance(node, ast.Import):
+                if any(a.name in _TOPOLOGY_MODULES for a in node.names):
+                    return True
+        return False

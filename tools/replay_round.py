@@ -2,7 +2,13 @@
 
 Every execution path is deterministic in ``(config, seed)``: the
 training stream is the split chain of ``PRNGKey(seed)`` and the fault
-stream is pure in ``(fault_seed, round)``.  A flight-recorder dump
+stream is pure in ``(fault_seed, round)``.  That includes decentralized
+gossip rounds (``execution="gossip"``): the peer graph rebuilds from
+``topology_config`` (``graph_seed`` pins the random families), the
+edge-dropout realization is pure in ``(fault_seed, round)``, and the
+per-node replica stack replays through the same round keys — so
+``gossip_ici_bytes`` / ``num_partitioned_nodes`` / ``consensus_dist``
+compare bit-for-bit like every other digest field.  A flight-recorder dump
 (:mod:`blades_tpu.obs.flightrec`) therefore carries everything needed
 to re-execute the failing round in isolation — no model state rides
 the dump.  This CLI rebuilds the trial config from the dump, re-runs

@@ -13,7 +13,9 @@ two artifact classes in ISSUE 12; this CLI is the one front door:
   re-ordered streams and is reported as an error — and the pod-scale
   row contract: ``ici_bytes`` / ``preagg_kept`` / ``mesh_shape`` are
   stamped together by the hierarchical driver, so a partial stamp is
-  an error;
+  an error — and the decentralized-round contract: ``gossip_ici_bytes``
+  travels with the topology provenance and the per-round gossip
+  counters (blades_tpu/topology), same partial-stamp rule;
 - ``--flightrec``: ``flightrec.json`` dumps
   (:func:`blades_tpu.obs.flightrec.validate_flightrec`);
 - ``--trace``: Chrome/Perfetto span-trace exports
@@ -118,6 +120,56 @@ def _mesh_row_errors(path):
     return errors
 
 
+def _gossip_row_errors(path):
+    """Decentralized-round row consistency over a metrics.jsonl stream:
+    the six gossip stamps travel together (a row with
+    ``gossip_ici_bytes`` must carry the topology provenance and both
+    per-round counters), counters are in range, and the graph family is
+    one the topology subsystem builds — a partial stamp means the driver
+    and the gossip recorder disagreed about which path ran."""
+    import json
+
+    errors = []
+    with open(path) as f:
+        for lineno, line in enumerate(f, start=1):
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if not isinstance(rec, dict) or "gossip_ici_bytes" not in rec:
+                continue
+            missing = [k for k in ("topology", "graph_seed", "spectral_gap",
+                                   "num_partitioned_nodes", "consensus_dist")
+                       if k not in rec]
+            if missing:
+                errors.append((lineno,
+                               f"gossip row missing {missing}: the six "
+                               "gossip stamps are stamped together by the "
+                               "gossip driver"))
+                continue
+            if (rec["gossip_ici_bytes"] < 0
+                    or rec["num_partitioned_nodes"] < 0):
+                errors.append((lineno,
+                               "gossip counters out of range: "
+                               f"gossip_ici_bytes={rec['gossip_ici_bytes']}, "
+                               "num_partitioned_nodes="
+                               f"{rec['num_partitioned_nodes']}"))
+            if not 0.0 <= float(rec["spectral_gap"]) <= 1.0:
+                errors.append((lineno,
+                               "spectral_gap must be in [0, 1], got "
+                               f"{rec['spectral_gap']!r}"))
+            # graph.py is host-side numpy — no jax import for a validator
+            from blades_tpu.topology.graph import GRAPHS
+
+            if rec["topology"] not in GRAPHS:
+                errors.append((lineno,
+                               f"unknown topology {rec['topology']!r}; "
+                               f"the subsystem builds {GRAPHS}"))
+    return errors
+
+
 def _report(path, num_ok: int, what: str, errors) -> int:
     print(f"{path}: {num_ok} valid {what}, {len(errors)} error(s)")
     for err in errors:
@@ -189,7 +241,7 @@ def main(argv=None) -> int:
 
             num, errors = validate_jsonl(path)
             errors = (list(errors) + _async_tick_errors(path)
-                      + _mesh_row_errors(path))
+                      + _mesh_row_errors(path) + _gossip_row_errors(path))
             rc |= _report(path, num, "record(s)", errors)
     return rc
 
