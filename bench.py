@@ -30,7 +30,12 @@ Two measured workloads, one JSON line:
    participation window — resident vs host out-of-core client-state
    staging (``blades_tpu/state``) plus a large-n host-only point —
    reporting staging telemetry next to the wall times, on both
-   backends.  And env-gated ``BLADES_BENCH_LEDGER``: the same protocol
+   backends.  And env-gated ``BLADES_BENCH_DATASTORE``: the windowed
+   protocol with the TRAINING DATA resident vs in the disk-backed
+   memmap store (``blades_tpu/data/store.py``) — cohort shard gathers
+   + the chunked streaming evaluator — reporting ``data_stage_ms``/
+   ``data_bytes_staged``/``eval_chunks`` next to the wall times, on
+   both backends.  And env-gated ``BLADES_BENCH_LEDGER``: the same protocol
    with the client-lifetime ledger (``blades_tpu/obs/ledger.py``)
    folding the full cohort every round vs bare, held to the PR 12 <2%
    overhead bar, on both backends.  And env-gated ``BLADES_BENCH_MESH``:
@@ -1293,6 +1298,76 @@ def _ooc_block(cpu: bool) -> dict:
     return out
 
 
+def _measure_datastore_round(backend: str, *, num_clients=32, window=8,
+                             num_byzantine=8, timed_rounds=3, model="cnn",
+                             dataset="cifar10", adversary="ALIE") -> dict:
+    """One arm of the BLADES_BENCH_DATASTORE A/B (ISSUE 20): the
+    32-client windowed protocol with the TRAINING DATA in the
+    ``backend`` data store ("resident" stages cohorts from host numpy
+    exactly as before; "memmap" gathers them from CRC'd disk shards and
+    streams eval in device-sized chunks).  The state store stays
+    resident in both arms so the delta isolates the data plane; one
+    eval runs inside the arm so the memmap side exercises the chunked
+    evaluator and stamps ``eval_chunks``."""
+    from blades_tpu.algorithms import FedavgConfig
+
+    cfg = (
+        FedavgConfig()
+        .data(dataset=dataset, num_clients=num_clients, seed=0)
+        .training(global_model=model, server_lr=0.5,
+                  train_batch_size=BATCH,
+                  num_batch_per_round=LOCAL_STEPS,
+                  aggregator={"type": "Median"})
+        .client(lr=0.1, momentum=0.9)
+        .adversary(num_malicious_clients=num_byzantine,
+                   adversary_config={"type": adversary})
+        .evaluation(evaluation_interval=0)
+        .resources(execution="dense", window=window,
+                   data_store=backend, eval_chunk_clients=8)
+    )
+    algo = cfg.build()
+    try:
+        row = algo.train()  # compile + settle outside the timed loop
+        t0 = time.perf_counter()
+        for _ in range(timed_rounds):
+            row = algo.train()
+        dt = time.perf_counter() - t0
+        final_loss = float(row["train_loss"])
+        assert final_loss == final_loss  # NaN guard
+        ev = algo.evaluate()
+        return {
+            "rounds_per_sec": round(timed_rounds / dt, 4),
+            "clients": num_clients, "window": window,
+            "byzantine": num_byzantine, "model": model,
+            "batch": BATCH, "local_steps": LOCAL_STEPS,
+            "timed_rounds": timed_rounds, "aggregator": "Median",
+            "adversary": adversary, "path": "windowed_dense",
+            "data_store": row.get("data_store", backend),
+            "data_stage_ms": row.get("data_stage_ms"),
+            "data_bytes_staged": row.get("data_bytes_staged"),
+            "test_acc": round(float(ev["test_acc"]), 4),
+            "eval_chunks": ev.get("eval_chunks"),
+        }
+    finally:
+        algo.stop()
+
+
+def _datastore_block(cpu: bool) -> dict:
+    """BLADES_BENCH_DATASTORE satellite (ISSUE 20): resident-vs-memmap
+    A/B on the 32-client windowed protocol — the shard-gather + chunked-
+    eval overhead the disk-backed data store pays for its O(cohort)
+    host-memory ceiling.  Rides TPU main and cpu_fallback; cpu_fallback
+    numbers compare only with each other."""
+    timed = 2 if cpu else 3
+    resident = _measure_datastore_round("resident", timed_rounds=timed)
+    memmap = _measure_datastore_round("memmap", timed_rounds=timed)
+    out = {"resident": resident, "memmap": memmap}
+    if resident["rounds_per_sec"]:
+        out["memmap_over_resident"] = round(
+            memmap["rounds_per_sec"] / resident["rounds_per_sec"], 3)
+    return out
+
+
 def _measure_control_arm(controlled: bool, *, num_clients=32,
                          num_byzantine=8, rounds=12, model="cnn",
                          dataset="cifar10") -> dict:
@@ -1476,6 +1551,14 @@ def _cpu_fallback(probe_err: str) -> None:
             out["ooc"] = _ooc_block(cpu=True)
         except Exception as e:
             out["ooc"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+    if os.environ.get("BLADES_BENCH_DATASTORE", "1") == "1":
+        try:
+            # Out-of-core training data (ISSUE 20) on the reduced CPU
+            # config — resident vs memmap cohort-data staging + the
+            # chunked streaming evaluator.
+            out["datastore"] = _datastore_block(cpu=True)
+        except Exception as e:
+            out["datastore"] = {"error": f"{type(e).__name__}: {e}"[:300]}
     if os.environ.get("BLADES_BENCH_CONTROL", "1") == "1":
         try:
             # Closed-loop control plane (ISSUE 17) on the reduced CPU
@@ -1641,6 +1724,16 @@ def main() -> None:
             out["ooc"] = _ooc_block(cpu=False)
         except Exception as e:
             out["ooc"] = {"error": f"{type(e).__name__}: {e}"[:500]}
+
+    if os.environ.get("BLADES_BENCH_DATASTORE", "1") == "1":
+        try:
+            # Out-of-core training data (ISSUE 20): resident vs memmap
+            # cohort-data staging on the 32-client windowed protocol +
+            # the chunked streaming evaluator — the shard-gather
+            # overhead paid for the O(cohort) host-memory ceiling.
+            out["datastore"] = _datastore_block(cpu=False)
+        except Exception as e:
+            out["datastore"] = {"error": f"{type(e).__name__}: {e}"[:500]}
 
     if os.environ.get("BLADES_BENCH_CONTROL", "1") == "1":
         try:

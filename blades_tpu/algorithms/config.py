@@ -172,6 +172,29 @@ class FedavgConfig:
         # (None = a private temp dir, removed when the trial stops).
         # Checkpoints stream their own per-shard files either way.
         self.state_dir: Optional[str] = None
+        # Out-of-core TRAINING DATA (blades_tpu/data/store.py): where
+        # the per-client (x, y, lengths) partition lives on the
+        # windowed / out-of-core-async paths.  "resident" (default)
+        # keeps the host numpy stacks and stages cohorts exactly as
+        # before — bit-identical by construction.  "memmap" spills the
+        # partition to sharded on-disk .npy files (CRC'd manifest,
+        # ClientStateStore's shard discipline) and gathers only the
+        # cohort's rows per round, so host RSS scales with the COHORT,
+        # not the registered population; eval streams the test stack
+        # through the device in bounded chunks.  Both backends are
+        # bit-identical for the same (seed, cohort schedule).  Ignored
+        # (must stay "resident") on the dense full-participation paths,
+        # which never stage per-cohort data.
+        self.data_store: str = "resident"
+        # Directory for the memmap data store's live shards (None = a
+        # private temp dir, removed when the trial stops).  A directory
+        # whose manifest + CRCs match the partition is REUSED on
+        # resume; any mismatch rebuilds the shards from source.
+        self.data_dir: Optional[str] = None
+        # Streaming-eval chunk size (clients per jitted eval dispatch)
+        # when data_store="memmap" — the device holds one chunk of the
+        # test stack at a time, never the full population.
+        self.eval_chunk_clients: int = 256
         # failure detection / elastic recovery (core/health.py): zero
         # non-finite client lanes, skip non-finite server updates
         self.health_check: bool = False
@@ -361,6 +384,7 @@ class FedavgConfig:
                   client_packing=None, mxu_finish=None, autotune=None,
                   autotune_cache_dir=None, tuned_plan=None,
                   state_store=None, window=None, state_dir=None,
+                  data_store=None, data_dir=None, eval_chunk_clients=None,
                   mesh_shape=None, preagg=None, bucket_size=None):
         """``state_store=`` / ``window=`` / ``state_dir=`` configure the
         out-of-core participation-window store (blades_tpu/state):
@@ -368,7 +392,11 @@ class FedavgConfig:
         clients, the degenerate case), ``state_store`` where the
         off-cohort rows live (``resident`` | ``host`` | ``disk``).
         ``window=0`` must be passed explicitly — ``_set`` drops
-        ``None`` kwargs, so the sentinel distinction is deliberate."""
+        ``None`` kwargs, so the sentinel distinction is deliberate.
+        ``data_store=`` / ``data_dir=`` / ``eval_chunk_clients=`` are
+        the TRAINING-DATA analogue (blades_tpu/data/store.py):
+        ``memmap`` spills the partition to disk shards and streams
+        eval in device-sized chunks."""
         if window is not None:
             self._set(state_window=int(window))
         return self._set(num_devices=num_devices, execution=execution,
@@ -379,7 +407,10 @@ class FedavgConfig:
                          mxu_finish=mxu_finish, autotune=autotune,
                          autotune_cache_dir=autotune_cache_dir,
                          tuned_plan=tuned_plan, state_store=state_store,
-                         state_dir=state_dir, mesh_shape=mesh_shape,
+                         state_dir=state_dir, data_store=data_store,
+                         data_dir=data_dir,
+                         eval_chunk_clients=eval_chunk_clients,
+                         mesh_shape=mesh_shape,
                          preagg=preagg, bucket_size=bucket_size)
 
     def fault_tolerance(self, *, health_check=None, faults=None):
@@ -392,14 +423,20 @@ class FedavgConfig:
     def arrivals(self, *, rate=None, rate_schedule=None, slow_fraction=None,
                  slow_factor=None, agg_every=None, buffer_capacity=None,
                  staleness_cap=None, weight_schedule=None, weight_power=None,
-                 weight_cutoff=None, seed=None, max_ticks_per_cycle=None):
+                 weight_cutoff=None, seed=None, max_ticks_per_cycle=None,
+                 ticks_per_sec=None):
         """Buffered-async arrival spec (:class:`blades_tpu.arrivals.
         AsyncSpec`) for ``execution="async"``: the Poisson arrival rate
         (+ schedule / slow-cohort knobs), the FedBuff buffer geometry
         (``agg_every`` K, bounded ``buffer_capacity``), the params-
         history depth (``staleness_cap`` H) and the staleness weight
-        schedule.  Merges into ``async_config``; see the README "Async
-        buffered execution" section."""
+        schedule.  ``ticks_per_sec`` is a pure CALIBRATION label (virtual
+        ticks per wall second) that lets ``updates_per_sec`` targets
+        drive buffer/agg_every sizing via
+        :func:`blades_tpu.arrivals.size_for_target`; it never enters the
+        arrival realization, which stays pure in ``(seed, tick)``.
+        Merges into ``async_config``; see the README "Async buffered
+        execution" section."""
         spec = dict(self.async_config or {})
         for k, v in (("rate", rate), ("rate_schedule", rate_schedule),
                      ("slow_fraction", slow_fraction),
@@ -409,7 +446,8 @@ class FedavgConfig:
                      ("weight_schedule", weight_schedule),
                      ("weight_power", weight_power),
                      ("weight_cutoff", weight_cutoff), ("seed", seed),
-                     ("max_ticks_per_cycle", max_ticks_per_cycle)):
+                     ("max_ticks_per_cycle", max_ticks_per_cycle),
+                     ("ticks_per_sec", ticks_per_sec)):
             if v is not None:
                 spec[k] = v
         return self._set(async_config=spec or None)
@@ -433,16 +471,19 @@ class FedavgConfig:
                 max_quarantine_fraction=None, min_agg_every=None,
                 agg_every_factor=None, buffer_factor=None,
                 max_buffer_capacity=None, cutoff_factor=None,
-                max_weight_cutoff=None):
+                max_weight_cutoff=None, min_window=None,
+                window_factor=None):
         """Closed-loop control plane (:mod:`blades_tpu.control`):
         watchdog events drive bounded, rate-limited, journaled actuator
         moves.  ``rules=`` maps watchdog rule NAMES to actuator families
         (``agg_every`` | ``buffer`` | ``quarantine`` | ``replan`` |
-        ``"off"``), merged over the default table; the remaining knobs
-        are :class:`~blades_tpu.control.ControlPolicy` bounds and rate
-        limits.  Merges into ``control_config`` (the ``.arrivals()``
-        pattern); a bare ``.control()`` arms the defaults.  See the
-        README "Control plane" section."""
+        ``window`` | ``"off"``), merged over the default table; the
+        remaining knobs are :class:`~blades_tpu.control.ControlPolicy`
+        bounds and rate limits (``min_window``/``window_factor`` bound
+        the out-of-core shrink-only ``window`` family).  Merges into
+        ``control_config`` (the ``.arrivals()`` pattern); a bare
+        ``.control()`` arms the defaults.  See the README "Control
+        plane" section."""
         spec = dict(self.control_config or {})
         for k, v in (("enabled", enabled), ("rules", rules),
                      ("cooldown_rounds", cooldown_rounds),
@@ -454,7 +495,9 @@ class FedavgConfig:
                      ("buffer_factor", buffer_factor),
                      ("max_buffer_capacity", max_buffer_capacity),
                      ("cutoff_factor", cutoff_factor),
-                     ("max_weight_cutoff", max_weight_cutoff)):
+                     ("max_weight_cutoff", max_weight_cutoff),
+                     ("min_window", min_window),
+                     ("window_factor", window_factor)):
             if v is not None:
                 spec[k] = v
         if not spec:
@@ -998,6 +1041,37 @@ class FedavgConfig:
                         f"state_window={w} × {why} is an unsupported "
                         f"pair — set {flip}, or run without the "
                         "participation window")
+        # Out-of-core TRAINING DATA (blades_tpu/data/store.py): the
+        # memmap backend only engages on the paths that stage per-cohort
+        # data — windowed dense, or async × out-of-core state.  Same
+        # fail-fast discipline as the state store above.
+        from blades_tpu.data.store import DATA_STORE_BACKENDS
+
+        if self.data_store not in DATA_STORE_BACKENDS:
+            raise ValueError(
+                f"data_store must be one of {DATA_STORE_BACKENDS}, got "
+                f"{self.data_store!r}")
+        if self.data_store == "memmap":
+            ooc_async = (self.execution == "async"
+                         and self.state_store != "resident")
+            if not ((w is not None and w >= 1) or ooc_async):
+                raise ValueError(
+                    "data_store='memmap' needs a per-cohort staging path: "
+                    "set .resources(window=...) >= 1 (windowed dense) or "
+                    "execution='async' with an out-of-core state_store — "
+                    "the full-participation rounds hold the whole "
+                    "partition on device and never stage cohort data")
+        elif self.data_dir:
+            raise ValueError(
+                "data_dir is set but data_store='resident' — set "
+                ".resources(data_store='memmap') (data_dir names the "
+                "memmap backend's live shard directory) or drop data_dir"
+            )
+        if not isinstance(self.eval_chunk_clients, int) \
+                or self.eval_chunk_clients < 1:
+            raise ValueError(
+                f"eval_chunk_clients must be an int >= 1, got "
+                f"{self.eval_chunk_clients!r}")
         # Client-lifetime ledger (obs/ledger.py): fail-fast on a bad
         # backend value, and name the one structurally impossible pair.
         self.ledger_backend
@@ -1115,8 +1189,11 @@ class FedavgConfig:
                     f"control agg_every/buffer moves × state_store="
                     f"{self.state_store!r} is an unsupported pair: the "
                     "out-of-core store sizes its staging rows by the "
-                    "initial agg_every — set state_store='resident', or "
-                    "map those rules 'off' in .control(rules=...)"
+                    "initial agg_every, and both families can GROW the "
+                    "staged set — map those rules to the shrink-only "
+                    "'window' family in .control(rules=...) (bounded by "
+                    "min_window/window_factor), map them 'off', or set "
+                    "state_store='resident'"
                 )
         if self.client_packing not in ("off", "auto", None):
             # Forced int P: structural impossibilities fail at validate()

@@ -96,9 +96,38 @@ class Fedavg:
         else:
             self._train_arrays = tuple(jnp.asarray(a)
                                        for a in self._host_train)
-        tx = jnp.asarray(self.dataset.test.x)
-        ty = jnp.asarray(self.dataset.test.y)
-        tln = jnp.asarray(self.dataset.test.lengths)
+        # Out-of-core training data (blades_tpu/data/store): on the
+        # cohort-shaped paths the data plane sits behind a DataStore —
+        # `resident` reproduces the legacy host-array staging ops
+        # bit-for-bit, `memmap` holds the shards as sharded memory-
+        # mapped files so host RSS tracks the cohort, not the
+        # registration count.  Dense full-participation paths keep
+        # their device-resident stacks untouched.
+        self._data_store = None  # DataStore handle (None = legacy plane)
+        self._data_pf = None     # DataPrefetcher staging adapter
+        self._eval_chunk_fn = None  # jitted streaming-eval chunk program
+        self._eval_chunks = 0    # chunks walked by the last streaming eval
+        if self._windowed or ooc_async:
+            from blades_tpu.data.store import make_data_store
+            from blades_tpu.data.stream import DataPrefetcher
+
+            self._data_store = make_data_store(
+                getattr(cfg, "data_store", "resident"), self._host_train,
+                directory=getattr(cfg, "data_dir", None))
+            self._data_pf = DataPrefetcher(self._data_store)
+        # Streaming eval rides the memmap data plane: the test stack
+        # stays HOST-resident and evaluate() walks it in bounded
+        # device-sized chunks instead of device-putting it whole.
+        streaming_eval = (self._data_store is not None
+                          and self._data_store.backend == "memmap")
+        if streaming_eval:
+            tx = self.dataset.test.x
+            ty = self.dataset.test.y
+            tln = self.dataset.test.lengths
+        else:
+            tx = jnp.asarray(self.dataset.test.x)
+            ty = jnp.asarray(self.dataset.test.y)
+            tln = jnp.asarray(self.dataset.test.lengths)
         cap = cfg.evaluation_num_samples
         if cap is not None and cap < tx.shape[1]:
             # Per-client eval subsample: bounds device memory + eval cost
@@ -115,13 +144,27 @@ class Fedavg:
                 k = int(tln[i])
                 pick[i] = (rng.choice(k, size=cap, replace=False)
                            if k >= cap else np.arange(cap) % max(k, 1))
-            tx = jnp.take_along_axis(
-                tx, jnp.asarray(pick).reshape((n, cap) + (1,) * (tx.ndim - 2)),
-                axis=1,
-            )
-            ty = jnp.take_along_axis(ty, jnp.asarray(pick), axis=1)
-            tln = jnp.minimum(tln, cap)
+            if streaming_eval:
+                # Host twin of the device subsample below — the memmap
+                # plane keeps the test stack off the device entirely.
+                tx = np.take_along_axis(
+                    tx, pick.reshape((n, cap) + (1,) * (tx.ndim - 2)),
+                    axis=1)
+                ty = np.take_along_axis(ty, pick, axis=1)
+                tln = np.minimum(tln, cap)
+            else:
+                tx = jnp.take_along_axis(
+                    tx,
+                    jnp.asarray(pick).reshape((n, cap) + (1,) * (tx.ndim - 2)),
+                    axis=1,
+                )
+                ty = jnp.take_along_axis(ty, jnp.asarray(pick), axis=1)
+                tln = jnp.minimum(tln, cap)
         self._test_arrays = (tx, ty, tln)
+        if streaming_eval:
+            from blades_tpu.data.stream import make_chunk_evaluator
+
+            self._eval_chunk_fn = make_chunk_evaluator(self.fed_round.task)
 
         # Execution autotuner (perf/autotune.py): resolve the measured
         # plan — or the checkpoint/operator pin, or the cached winner —
@@ -177,6 +220,7 @@ class Fedavg:
                 train_seed=int(cfg.seed),
                 fault_injector=cfg.get_fault_injector(),
                 state_store=self._state_store,
+                data_store=self._data_pf,
                 forensics=bool(cfg.forensics),
             )
             self.state = _dc_replace(
@@ -379,6 +423,13 @@ class Fedavg:
                     agg_every=int(self._async.agg_every),
                     buffer_capacity=int(self._async.buffer.capacity),
                     weight_cutoff=int(self._async.weight_cutoff),
+                    # Out-of-core window actuator: under a state store
+                    # the event-cohort size IS the participation
+                    # window, and `window` is the one journaled move
+                    # allowed to shrink it (agg_every/buffer moves are
+                    # validate()-rejected there — see config.py).
+                    window=(int(self._async.agg_every)
+                            if self._state_store is not None else None),
                     allow_replan=False,  # async × autotune is forbidden
                 )
             else:
@@ -477,7 +528,12 @@ class Fedavg:
             cfg.state_store, n, self._row_template,
             directory=getattr(cfg, "state_dir", None))
         self._state_pf = StatePrefetcher(
-            self._state_store, self._host_train, np.asarray(self.malicious),
+            self._state_store,
+            # Out-of-core data plane: cohort shards ride the state
+            # worker through the DataPrefetcher (always built on the
+            # windowed path; `resident` reproduces the host-array ops).
+            self._data_pf if self._data_pf is not None else self._host_train,
+            np.asarray(self.malicious),
             lambda k: sample_cohort(k, n, w),
             async_staging=self._resolve_prefetch(),
         )
@@ -1093,6 +1149,25 @@ class Fedavg:
         }
 
     @property
+    def data_summary(self) -> Optional[Dict]:
+        """Out-of-core training-data digest for sweep summaries
+        (backend, population/row bytes, last staging cost, eval
+        chunking), or ``None`` when the data plane is the legacy dense
+        one."""
+        if self._data_store is None:
+            return None
+        stats = self._data_pf.stats
+        return {
+            "backend": self._data_store.backend,
+            "n_clients": int(self._data_store.n_clients),
+            "row_bytes": int(self._data_store.row_bytes),
+            "total_bytes": int(self._data_store.total_bytes()),
+            "last_stage_ms": round(stats.last_stage_ms, 3),
+            "last_bytes_staged": int(stats.last_bytes_staged),
+            "eval_chunks": int(self._eval_chunks),
+        }
+
+    @property
     def client_ledger(self):
         """The live :class:`~blades_tpu.obs.ledger.ClientLedger`, or
         ``None`` when the ledger is off — the sweep attaches it to the
@@ -1270,6 +1345,16 @@ class Fedavg:
             row["state_stage_ms"] = round(stats.last_stage_ms, 3)
             row["state_bytes_staged"] = int(stats.last_bytes_staged)
             row["state_peak_hbm_bytes"] = int(stats.peak_hbm_bytes)
+        if self._data_store is not None:
+            # Out-of-core data staging digest (blades_tpu/data): host
+            # counters the DataPrefetcher already holds — no device
+            # fetch to defer.  data_bytes_staged is the LAST cohort/
+            # event gather's device-put volume, the number the 1M
+            # acceptance test pins against a cohort-proportional bound.
+            dstats = self._data_pf.stats
+            row["data_store"] = self._data_store.backend
+            row["data_stage_ms"] = round(dstats.last_stage_ms, 3)
+            row["data_bytes_staged"] = int(dstats.last_bytes_staged)
         if self._cache_wrappers:
             # Per-trial AOT compile-cache counters (obs schema fields):
             # cumulative over this trial's dispatches, so the first row
@@ -1517,6 +1602,12 @@ class Fedavg:
                 eng.set_buffer_capacity(int(act.new))
             elif act.actuator == "weight_cutoff" and eng is not None:
                 eng.set_weight_cutoff(int(act.new))
+            elif act.actuator == "window" and eng is not None:
+                # Out-of-core participation window: the event-cohort
+                # size under a state store IS the engine's agg_every —
+                # a window shrink re-geometries the cycle (and the
+                # store gathers) without touching the store itself.
+                eng.set_agg_every(int(act.new))
             elif act.actuator in ("quarantine", "probe", "readmit",
                                   "requarantine"):
                 if eng is not None:
@@ -1597,12 +1688,32 @@ class Fedavg:
     def evaluate(self) -> Dict:
         """Weighted per-client evaluation (ref: fedavg.py:247-279)."""
         with self.timers.time("evaluate"):
-            ev = self._evaluate(self.state, *self._test_arrays)
-            self._last_eval = {
-                "test_loss": float(ev["test_loss"]),
-                "test_acc": float(ev["test_acc"]),
-                "test_acc_top3": float(ev["test_acc_top3"]),
-            }
+            if self._eval_chunk_fn is not None:
+                # Streaming eval (blades_tpu/data/stream): walk the
+                # host test stack in bounded device-sized chunks — the
+                # full stack is never device-put.  Differs from the
+                # monolithic reduction only in summation order.
+                from blades_tpu.data.stream import streaming_evaluate
+
+                ev, n_chunks = streaming_evaluate(
+                    self._eval_chunk_fn, self.state.server.params,
+                    self._test_arrays,
+                    chunk_clients=int(getattr(
+                        self.config, "eval_chunk_clients", 256) or 256))
+                self._eval_chunks = int(n_chunks)
+                self._last_eval = {
+                    "test_loss": float(ev["test_loss"]),
+                    "test_acc": float(ev["test_acc"]),
+                    "test_acc_top3": float(ev["test_acc_top3"]),
+                    "eval_chunks": int(n_chunks),
+                }
+            else:
+                ev = self._evaluate(self.state, *self._test_arrays)
+                self._last_eval = {
+                    "test_loss": float(ev["test_loss"]),
+                    "test_acc": float(ev["test_acc"]),
+                    "test_acc_top3": float(ev["test_acc_top3"]),
+                }
         return dict(self._last_eval)
 
     # -- compiled-cost analysis (obs subsystem) ------------------------------
@@ -1676,6 +1787,17 @@ class Fedavg:
                            if self._state_pf is not None else None),
                 "n_registered": self.config.num_clients,
             } if self._state_store is not None else None),
+            # Out-of-core data provenance (blades_tpu/data): training
+            # data is immutable and rebuildable from the dataset, so
+            # the shard manifest is REFERENCED, never copied — the
+            # checkpoint records which backend/directory served the
+            # run and its population; resume re-opens (or rebuilds)
+            # the cache from source.
+            "data_store": ({
+                "backend": self._data_store.backend,
+                "dir": getattr(self._data_store, "directory", None),
+                "n_clients": int(self._data_store.n_clients),
+            } if self._data_store is not None else None),
             # Which client sits in each stacked row (the d-sharded
             # elision layout permutes clients at setup): lets a resume
             # under a DIFFERENT execution mode realign per-client state
@@ -1853,6 +1975,22 @@ class Fedavg:
                 "the window — stateful aggregators may not restore "
                 "cleanly", RuntimeWarning, stacklevel=2)
 
+        saved_data = payload.get("data_store")
+        if saved_data:
+            cur_backend = (self._data_store.backend
+                           if self._data_store is not None else "resident")
+            if saved_data.get("backend") != cur_backend:
+                # Data backends are bit-identical by contract, so this
+                # is provenance drift, not a numeric fork — but a
+                # resume that silently changed where training shards
+                # live should be operator-visible.
+                warnings.warn(
+                    "checkpoint was written under data_store="
+                    f"{saved_data.get('backend')!r}; resuming under "
+                    f"{cur_backend!r} (values are unaffected — data "
+                    "backends are bit-identical by contract)",
+                    RuntimeWarning, stacklevel=2)
+
         faults = self.fed_round.faults
         if (self._state_store is None and faults is not None
                 and faults.needs_stale_buffer
@@ -1909,9 +2047,13 @@ class Fedavg:
                     # arrivals payload; re-assert from the controller's
                     # view only where an older payload left defaults.
                     v = self._controller.values
-                    if (v.get("agg_every")
-                            and int(v["agg_every"]) != self._async.agg_every):
-                        self._async.set_agg_every(int(v["agg_every"]))
+                    # Under an out-of-core store the `window` view is
+                    # the live cohort size (window moves actuate
+                    # set_agg_every); prefer it over the untouched
+                    # agg_every view so a resumed shrink is kept.
+                    want_k = v.get("window") or v.get("agg_every")
+                    if want_k and int(want_k) != self._async.agg_every:
+                        self._async.set_agg_every(int(want_k))
                     if (v.get("weight_cutoff") is not None
                             and int(v["weight_cutoff"])
                             != self._async.weight_cutoff):
@@ -1970,5 +2112,7 @@ class Fedavg:
             self._state_pf.close()
         if self._state_store is not None:
             self._state_store.close()
+        if self._data_pf is not None:
+            self._data_pf.close()  # closes the DataStore behind it too
         if self._ledger is not None:
             self._ledger.close()

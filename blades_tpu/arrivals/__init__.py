@@ -21,7 +21,12 @@ execution" section.
 """
 
 from blades_tpu.arrivals.buffer import ArrivalEvent, UpdateBuffer  # noqa: F401
-from blades_tpu.arrivals.engine import AsyncEngine, AsyncSpec  # noqa: F401
+from blades_tpu.arrivals.engine import (  # noqa: F401
+    AsyncEngine,
+    AsyncSpec,
+    expected_arrivals_per_sec,
+    size_for_target,
+)
 from blades_tpu.arrivals.process import ArrivalProcess  # noqa: F401
 from blades_tpu.arrivals.weights import (  # noqa: F401
     STALENESS_SCHEDULES,

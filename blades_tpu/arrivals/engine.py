@@ -72,6 +72,14 @@ class AsyncSpec:
         max_ticks_per_cycle: starvation guard — a cycle that cannot
             collect K unique-client arrivals within this many ticks
             raises instead of spinning forever.
+        ticks_per_sec: wall-clock calibration of the virtual tick
+            (``0.0`` = uncalibrated, the default).  PURELY a sizing /
+            reporting aid — it never enters the realization path
+            (``tick_key`` folds ``(seed, tick)`` and nothing else), so
+            two specs differing only here realize bit-identical
+            traffic.  :func:`size_for_target` consumes it to derive
+            ``agg_every``/``buffer_capacity`` from an
+            ``updates_per_sec`` target.
     """
 
     seed: int = 0
@@ -86,10 +94,15 @@ class AsyncSpec:
     weight_power: float = 0.5
     weight_cutoff: int = 16
     max_ticks_per_cycle: int = 100_000
+    ticks_per_sec: float = 0.0
 
     def __post_init__(self):
         if self.agg_every < 1:
             raise ValueError(f"agg_every must be >= 1, got {self.agg_every}")
+        if self.ticks_per_sec < 0:
+            raise ValueError(
+                f"ticks_per_sec must be >= 0 (0 = uncalibrated), got "
+                f"{self.ticks_per_sec}")
         if self.buffer_capacity and self.buffer_capacity < self.agg_every:
             raise ValueError(
                 f"buffer_capacity={self.buffer_capacity} < agg_every="
@@ -126,12 +139,62 @@ class AsyncSpec:
         )
 
 
+def expected_arrivals_per_sec(spec: AsyncSpec, num_clients: int) -> float:
+    """Expected wall-clock arrival supply of a CALIBRATED spec
+    (``ticks_per_sec > 0``): the per-tick Bernoulli mass over the
+    fast/slow lane split, scaled by the tick rate.  The schedule-free
+    base rate is used — a ``rate_schedule`` makes supply time-varying
+    and sizing should target the base regime."""
+    if spec.ticks_per_sec <= 0:
+        raise ValueError(
+            "expected_arrivals_per_sec needs a calibrated spec: set "
+            "ticks_per_sec > 0")
+    n_slow = int(spec.slow_fraction * num_clients)
+    n_fast = num_clients - n_slow
+    per_tick = n_fast * spec.rate + n_slow * spec.rate * spec.slow_factor
+    return float(per_tick * spec.ticks_per_sec)
+
+
+def size_for_target(spec: AsyncSpec, num_clients: int,
+                    target_updates_per_sec: float, *,
+                    agg_interval_sec: float = 1.0) -> AsyncSpec:
+    """Derive ``agg_every``/``buffer_capacity`` from a wall-clock
+    ``updates_per_sec`` target (ROADMAP item 5's calibrated-ticks
+    residual): size the aggregation batch so one cycle ingests about
+    ``agg_interval_sec`` worth of the targeted traffic, with the usual
+    ``2*K`` bounded buffer behind it.  Raises when the target exceeds
+    the spec's expected arrival supply — an operator asking for more
+    throughput than the fleet delivers must hear it at config time,
+    not starve at tick time.  Returns a new spec; the arrival
+    realization knobs (seed/rate/schedule) are untouched, so the
+    resized spec replays the identical traffic."""
+    supply = expected_arrivals_per_sec(spec, num_clients)
+    if target_updates_per_sec <= 0:
+        raise ValueError(
+            f"target_updates_per_sec must be > 0, got "
+            f"{target_updates_per_sec}")
+    if agg_interval_sec <= 0:
+        raise ValueError(
+            f"agg_interval_sec must be > 0, got {agg_interval_sec}")
+    if target_updates_per_sec > supply:
+        raise ValueError(
+            f"target_updates_per_sec={target_updates_per_sec:g} exceeds "
+            f"the spec's expected arrival supply {supply:g}/s "
+            f"(rate={spec.rate}, ticks_per_sec={spec.ticks_per_sec}, "
+            f"{num_clients} clients) — raise the rate/fleet or lower "
+            "the target")
+    agg_every = int(np.clip(
+        round(target_updates_per_sec * agg_interval_sec), 1, num_clients))
+    return dataclasses.replace(
+        spec, agg_every=agg_every, buffer_capacity=2 * agg_every)
+
+
 class AsyncEngine:
     """Host driver pairing an :class:`AsyncSpec` with a ``FedRound``."""
 
     def __init__(self, fed_round, spec: AsyncSpec, num_clients: int, *,
                  train_seed: int, fault_injector=None, state_store=None,
-                 forensics: bool = False):
+                 data_store=None, forensics: bool = False):
         if spec.agg_every > num_clients:
             raise ValueError(
                 f"agg_every={spec.agg_every} > num_clients={num_clients}: "
@@ -153,6 +216,12 @@ class AsyncEngine:
         # cycle program then carries (K, ...) cohort-windowed buffers
         # instead of the full (n, ...) stack).
         self.state_store = state_store
+        # Out-of-core data plane (blades_tpu/data): a DataPrefetcher
+        # over the training-shard store — the event cohort's data rows
+        # are gathered per cycle instead of indexing resident host
+        # stacks.  None = legacy host-array staging (bit-identical by
+        # the store contract either way).
+        self.data_store = data_store
         from blades_tpu.state.store import StoreStats
 
         self.store_stats = StoreStats()
@@ -400,9 +469,15 @@ class AsyncEngine:
 
             t0 = now()
             rows = self.state_store.gather(clients)
-            ex = jnp.asarray(np.asarray(data_x)[clients])
-            ey = jnp.asarray(np.asarray(data_y)[clients])
-            eln = jnp.asarray(np.asarray(lengths)[clients])
+            if self.data_store is not None:
+                # Shard-store gather (FIFO event order is fine — the
+                # memmap backend regroups by shard internally); the
+                # prefetcher observes data_stage_ms/data_bytes_staged.
+                ex, ey, eln = self.data_store.gather(clients)
+            else:
+                ex = jnp.asarray(np.asarray(data_x)[clients])
+                ey = jnp.asarray(np.asarray(data_y)[clients])
+                eln = jnp.asarray(np.asarray(lengths)[clients])
             staged = (len(clients) * self.state_store.row_bytes
                       + ex.nbytes + ey.nbytes + eln.nbytes)
             self.store_stats.observe(

@@ -32,6 +32,7 @@ from blades_tpu.control.policy import (
     decide_probe,
     decide_quarantine,
     decide_replan,
+    decide_window,
 )
 
 logger = logging.getLogger(__name__)
@@ -41,8 +42,11 @@ class Controller:
     """Per-trial closed-loop controller.
 
     ``values`` holds the controller's view of the live actuator values
-    (``agg_every``/``buffer_capacity``/``weight_cutoff``; None on the
-    sync driver, which has none of the three).  The driver seeds them at
+    (``agg_every``/``buffer_capacity``/``weight_cutoff``/``window``;
+    None on the sync driver, which has none of the four).  ``window``
+    is only seeded on out-of-core async drivers — it names the same
+    engine knob as ``agg_every`` but is the shrink-only family admitted
+    under ``state_store != "resident"``.  The driver seeds them at
     build time and applies every returned action back to the engine, so
     view and engine can only diverge if the driver drops an action —
     which the apply helpers log loudly.
@@ -52,6 +56,7 @@ class Controller:
                  agg_every: Optional[int] = None,
                  buffer_capacity: Optional[int] = None,
                  weight_cutoff: Optional[int] = None,
+                 window: Optional[int] = None,
                  allow_replan: bool = False):
         self.policy = policy
         self.num_clients = int(num_clients)
@@ -60,6 +65,7 @@ class Controller:
             "agg_every": agg_every,
             "buffer_capacity": buffer_capacity,
             "weight_cutoff": weight_cutoff,
+            "window": window,
         }
         self._cooldown_until: Dict[str, int] = {}
         self.quarantine: Dict[int, int] = {}  # client -> release round
@@ -153,6 +159,11 @@ class Controller:
                 self.policy, seq=self._seq, round_idx=round_idx,
                 tick=tick, rule=str(rule),
                 pre={"old": self.values["agg_every"]})
+        elif family == "window":
+            act = decide_window(
+                self.policy, seq=self._seq, round_idx=round_idx,
+                tick=tick, rule=str(rule),
+                pre={"old": self.values["window"]})
         elif family == "buffer":
             act = decide_buffer(
                 self.policy, seq=self._seq, round_idx=round_idx,

@@ -29,6 +29,15 @@ demand different responses) to actuator families:
                        autotuner against observed cohort geometry
                        (sync driver only — async x autotune is a
                        forbidden pair in config.validate())
+``window``             out-of-core staging pressure: shrink the async
+                       event-cohort window (floor ``min_window``) so a
+                       round's staged rows + data shards fit the host
+                       budget.  This is the ONLY agg-cadence family
+                       admitted under ``state_store != "resident"`` —
+                       it moves the same engine knob as ``agg_every``
+                       but is one-directional DOWN, so a journaled
+                       window trajectory can only ever tighten the
+                       out-of-core working set, never blow it up
 =====================  ===================================================
 
 Hysteresis by construction: every move is ONE-DIRECTIONAL and bounded
@@ -44,7 +53,8 @@ import dataclasses
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 #: Actuator families a rule may map to ("off" disables a rule's response).
-ACTUATOR_FAMILIES = ("agg_every", "buffer", "quarantine", "replan")
+ACTUATOR_FAMILIES = ("agg_every", "buffer", "quarantine", "replan",
+                     "window")
 
 #: Concrete actuator labels that appear in journaled actions.  The
 #: ``buffer`` family emits either ``buffer_capacity`` or
@@ -53,7 +63,7 @@ ACTUATOR_FAMILIES = ("agg_every", "buffer", "quarantine", "replan")
 #: of an earlier ``quarantine`` action, not event-driven moves.
 ACTION_ACTUATORS = ("agg_every", "buffer_capacity", "weight_cutoff",
                     "quarantine", "probe", "readmit", "requarantine",
-                    "replan")
+                    "replan", "window")
 
 #: Rule-name -> actuator-family table the default policy ships.  The
 #: names match obs/watchdog.py::default_rules(); user rules (the
@@ -130,6 +140,8 @@ class ControlPolicy:
     max_buffer_capacity: int = 256
     cutoff_factor: int = 2
     max_weight_cutoff: int = 256
+    min_window: int = 4
+    window_factor: int = 2
     seed: int = 0
 
     def __post_init__(self):
@@ -150,13 +162,17 @@ class ControlPolicy:
             raise ValueError("quarantine_max must be >= 1")
         if not (0.0 < self.max_quarantine_fraction <= 1.0):
             raise ValueError("max_quarantine_fraction must be in (0, 1]")
-        for knob in ("agg_every_factor", "buffer_factor", "cutoff_factor"):
+        for knob in ("agg_every_factor", "buffer_factor", "cutoff_factor",
+                     "window_factor"):
             if getattr(self, knob) < 2:
                 raise ValueError(f"{knob} must be >= 2 (a factor of 1 "
                                  "is a no-op move that would still burn "
                                  "the cooldown)")
         if self.min_agg_every < 1:
             raise ValueError("min_agg_every must be >= 1")
+        if self.min_window < 1:
+            raise ValueError("min_window must be >= 1 (an empty window "
+                             "would stage no cohort at all)")
 
     def actuator_for(self, rule_name: str) -> Optional[str]:
         for rule, family in self.rule_table:
@@ -221,6 +237,28 @@ def decide_agg_every(policy: ControlPolicy, *, seq: int, round_idx: int,
         actuator="agg_every", old=int(old), new=new, pre=dict(pre),
         message=f"shrink agg_every {old}->{new} (floor "
                 f"{policy.min_agg_every})")
+
+
+def decide_window(policy: ControlPolicy, *, seq: int, round_idx: int,
+                  tick: int, rule: str,
+                  pre: Dict[str, Any]) -> Optional[ControlAction]:
+    """Shrink the out-of-core event-cohort window toward ``min_window``
+    (smaller staged working set per aggregation).  ``pre = {"old":
+    current window}``.  Mirrors :func:`decide_agg_every` — one
+    direction, silent at the floor — but is admitted under
+    ``state_store != "resident"`` where the agg_every/buffer families
+    are config-rejected (growing either would grow the staged set)."""
+    old = pre.get("old")
+    if old is None:
+        return None  # sync / non-ooc driver: no window to move
+    new = max(policy.min_window, int(old) // policy.window_factor)
+    if new >= old:
+        return None  # at the floor — bounded means silent, not clamped
+    return ControlAction(
+        seq=seq, round=round_idx, tick=tick, rule=rule,
+        actuator="window", old=int(old), new=new, pre=dict(pre),
+        message=f"shrink window {old}->{new} (floor "
+                f"{policy.min_window})")
 
 
 def decide_buffer(policy: ControlPolicy, *, seq: int, round_idx: int,
@@ -378,6 +416,9 @@ def rederive_action(policy: ControlPolicy, action: Dict[str, Any], *,
     if actuator == "agg_every":
         out = decide_agg_every(policy, seq=seq, round_idx=round_idx,
                                tick=tick, rule=rule, pre=pre)
+    elif actuator == "window":
+        out = decide_window(policy, seq=seq, round_idx=round_idx,
+                            tick=tick, rule=rule, pre=pre)
     elif actuator in ("buffer_capacity", "weight_cutoff"):
         out = decide_buffer(policy, seq=seq, round_idx=round_idx,
                             tick=tick, rule=rule, pre=pre)

@@ -23,3 +23,18 @@ from blades_tpu.data.prefetch import (  # noqa: F401
     prefetch_to_device,
 )
 from blades_tpu.data.sampler import sample_batch, sample_client_batches  # noqa: F401
+from blades_tpu.data.store import (  # noqa: F401
+    DATA_STORE_BACKENDS,
+    DataStats,
+    DataStore,
+    DataStoreError,
+    MemmapDataStore,
+    ResidentDataStore,
+    make_data_store,
+    validate_datastore_dir,
+)
+from blades_tpu.data.stream import (  # noqa: F401
+    DataPrefetcher,
+    make_chunk_evaluator,
+    streaming_evaluate,
+)

@@ -1,0 +1,421 @@
+"""Out-of-core training data: the cohort-gather client data store.
+
+PR 15 moved per-client *state* behind the participation-window
+:class:`~blades_tpu.state.store.ClientStateStore`; this module is its
+**data-plane sibling**.  Before it, every execution path materialised
+all ``n_registered`` clients' training shards dense in host RAM
+(``O(n_registered * shard_bytes)``) and the eval path device-put the
+full test stack — so *data*, not state, was the registration ceiling
+blocking the 1M-registered / 10k-cohort serving rig (ROADMAP item 2).
+The reference benchmark (Blades, arXiv:2206.05359) never faced this
+because it simulates tens of clients; ByzFL (arXiv:2505.24802)
+likewise keeps every shard resident.  The fix is the same working-set
+move the state store made:
+
+- only the **sampled cohort**'s data rows are ever host-materialised
+  or device-resident (``take`` assembles exactly ``len(ids)`` rows);
+- the registered-population remainder lives behind a
+  :class:`DataStore` — ``resident`` (today's dense host arrays, the
+  bit-identical default) or ``memmap`` (sharded memory-mapped ``.npy``
+  files under a trial directory, so a 1M-client population costs page
+  cache, not RSS);
+- cohort gathers are pure in the round key (the ids come from
+  :func:`blades_tpu.state.store.sample_cohort` — sorted ascending, so
+  shard reads stay sequential) and are staged through
+  :class:`blades_tpu.data.stream.DataPrefetcher` riding the PR 15
+  worker discipline.
+
+The two backends are **bit-identical by contract**: ``take`` /
+``gather`` move rows without arithmetic, so the same (seed, cohort
+schedule) produces the same device shards, gradients and RoundState
+whichever backend holds the off-cohort rows (regression-tested in
+``tests/test_data_store.py``).
+
+Shard files follow the :mod:`blades_tpu.state.store` checkpoint
+discipline exactly — per-shard ``shard-<s>.l<j>.npy`` written
+atomically (tmp + fsync + ``os.replace``), per-file size + CRC32
+recorded, ``manifest.json`` published LAST.  One deliberate
+difference from the state store: training data is **immutable and
+derived from the dataset**, so the shard set is a *cache*, not the
+system of record.  A torn / corrupt / incomplete shard set found at
+open time is rebuilt from source instead of raising — the forensic
+walk that *names* what was wrong is :func:`validate_datastore_dir`
+(``tools/validate_metrics.py --datastore``).  Checkpoints reference
+the manifest (backend / directory / population provenance); they
+never copy shard payloads.
+
+This module is on the blades-lint ``host-sync`` DEVICE_SIDE list: the
+cohort ``take`` is the ONE sanctioned host-side assembly point of the
+data plane, and nothing here may block on the device — the sources
+are host arrays by construction.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import shutil
+import tempfile
+import zlib
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+DATA_STORE_BACKENDS = ("resident", "memmap")
+
+#: Client rows per shard file.  Matches the state store's sizing logic:
+#: one shard of a 4096-row MNIST-scale partition (~50 MB) stays under
+#: typical filesystem write buffers while a 1M-client store still
+#: splits into a few hundred independently-atomic files.
+DEFAULT_DATA_SHARD_ROWS = 4096
+
+DATA_STORE_FORMAT_VERSION = 1
+
+#: Leaf order of one client's training row: the padded example block,
+#: its labels, and the true (unpadded) shard length.
+DATA_LEAF_NAMES = ("x", "y", "lengths")
+
+
+class DataStoreError(RuntimeError):
+    """A shard directory that cannot be served faithfully: missing
+    manifest, population/layout drift, or a torn/corrupt shard file
+    (raised by the strict validation walk; the live store rebuilds its
+    cache instead)."""
+
+
+def _leaf_bytes(shapes, dtypes) -> int:
+    # math.prod over plain shape tuples: host arithmetic, no array ops.
+    return sum(math.prod(sh) * np.dtype(dt).itemsize
+               for sh, dt in zip(shapes, dtypes))
+
+
+class DataStore:
+    """Base class: the cohort-gather data store protocol.
+
+    One store holds the training shards of ``n_clients`` registered
+    clients as three stacked leaves — ``x (n, max_shard, *feat)``,
+    ``y (n, max_shard)``, ``lengths (n,)`` — and serves bounded row
+    subsets: :meth:`take` assembles host rows for a cohort,
+    :meth:`gather` wraps them into the device-facing staging API.
+    Rows are immutable (training data never changes mid-trial), so
+    unlike the state store there is no scatter/write-back leg and no
+    write-read hazard between consecutive cohorts.
+    """
+
+    backend = "abstract"
+
+    def __init__(self, n_clients: int, shapes: Sequence[tuple],
+                 dtypes: Sequence[np.dtype]):
+        if n_clients < 1:
+            raise ValueError(f"n_clients must be >= 1, got {n_clients}")
+        if len(shapes) != len(DATA_LEAF_NAMES):
+            raise ValueError(
+                f"a data row has {len(DATA_LEAF_NAMES)} leaves "
+                f"{DATA_LEAF_NAMES}, got {len(shapes)}")
+        self.n_clients = int(n_clients)
+        self._shapes = [tuple(sh) for sh in shapes]
+        self._dtypes = [np.dtype(dt) for dt in dtypes]
+        self.row_bytes = _leaf_bytes(self._shapes, self._dtypes)
+
+    # -- staging API ---------------------------------------------------------
+
+    def take(self, ids: np.ndarray) -> Tuple[np.ndarray, ...]:
+        """Host rows ``(x, y, lengths)`` stacked over ``ids`` (host
+        integer array, any order).  Pure data movement — values are
+        bit-equal across backends."""
+        raise NotImplementedError
+
+    def gather(self, ids: np.ndarray) -> Tuple[jnp.ndarray, ...]:
+        """Device rows for ``ids``: the :meth:`take` stack, device-put
+        leaf by leaf — byte-for-byte the legacy dense path's
+        ``jnp.asarray(x[ids])`` ops at cohort geometry."""
+        return tuple(jnp.asarray(a) for a in self.take(ids))
+
+    def total_bytes(self) -> int:
+        return self.row_bytes * self.n_clients
+
+    @property
+    def num_leaves(self) -> int:
+        return len(self._shapes)
+
+    def close(self) -> None:
+        pass
+
+
+class ResidentDataStore(DataStore):
+    """Today's dense host arrays behind the store protocol: the full
+    partition stays in host RAM exactly as the dataset loader built it,
+    and ``take`` is plain fancy indexing.  The bit-identical reference
+    the memmap backend is tested against — ``gather`` reproduces the
+    legacy staging ops literally."""
+
+    backend = "resident"
+
+    def __init__(self, arrays: Sequence[np.ndarray]):
+        x, y, lengths = arrays
+        n = int(np.shape(x)[0])
+        if int(np.shape(y)[0]) != n or int(np.shape(lengths)[0]) != n:
+            raise ValueError(
+                "data leaves disagree on the client axis: "
+                f"x={np.shape(x)[0]}, y={np.shape(y)[0]}, "
+                f"lengths={np.shape(lengths)[0]}")
+        super().__init__(n, [tuple(np.shape(a)[1:]) for a in arrays],
+                         [np.dtype(a.dtype) for a in arrays])
+        self._arrays = tuple(arrays)
+
+    def take(self, ids: np.ndarray) -> Tuple[np.ndarray, ...]:
+        ids = ids.astype(np.int64, copy=False)
+        return tuple(a[ids] for a in self._arrays)
+
+
+class MemmapDataStore(DataStore):
+    """Disk backend: sharded memory-mapped training shards under a
+    trial directory.  Each leaf's rows split into ``shard_rows``-row
+    ``shard-<s>.l<j>.npy`` files opened read-only, so the population
+    costs open file handles and page cache, not RSS — ``take`` touches
+    only the cohort's pages, and sorted cohort ids keep those reads
+    sequential.
+
+    Construction streams the source arrays to disk one shard at a
+    time (bounded memory at any population size — the sources may
+    themselves be numpy memmaps, in which case the full partition is
+    NEVER host-materialised), unless ``directory`` already holds a
+    manifest whose layout, sizes and CRC32s all verify — then the
+    existing shard set is reused as-is (the kill-and-resume path).
+    Any mismatch rebuilds the cache from source; the loud
+    name-the-file walk lives in :func:`validate_datastore_dir`.
+    """
+
+    backend = "memmap"
+
+    def __init__(self, arrays: Sequence[np.ndarray],
+                 directory: Optional[str] = None,
+                 shard_rows: int = DEFAULT_DATA_SHARD_ROWS):
+        x, y, lengths = arrays
+        n = int(np.shape(x)[0])
+        if int(np.shape(y)[0]) != n or int(np.shape(lengths)[0]) != n:
+            raise ValueError(
+                "data leaves disagree on the client axis: "
+                f"x={np.shape(x)[0]}, y={np.shape(y)[0]}, "
+                f"lengths={np.shape(lengths)[0]}")
+        super().__init__(n, [tuple(np.shape(a)[1:]) for a in arrays],
+                         [np.dtype(a.dtype) for a in arrays])
+        self._owns_dir = directory is None
+        self._dir = Path(directory or tempfile.mkdtemp(
+            prefix="blades_data_"))
+        self._dir.mkdir(parents=True, exist_ok=True)
+        self.shard_rows = int(shard_rows)
+        if not self._shards_verify():
+            self._write_shards(arrays)
+        self._maps: Dict[Tuple[int, int], np.memmap] = {}
+        for s, lo, hi in self._shard_ranges():
+            for j in range(self.num_leaves):
+                self._maps[(s, j)] = np.lib.format.open_memmap(
+                    self._dir / f"shard-{s:05d}.l{j:02d}.npy", mode="r")
+
+    @property
+    def directory(self) -> str:
+        return str(self._dir)
+
+    def _shard_ranges(self):
+        for s, lo in enumerate(range(0, self.n_clients, self.shard_rows)):
+            yield s, lo, min(lo + self.shard_rows, self.n_clients)
+
+    def _shards_verify(self) -> bool:
+        """True iff ``directory`` holds a complete shard set matching
+        this population's layout, every file passing size + CRC32 —
+        the reuse gate for resumed trials.  Anything less rebuilds."""
+        mpath = self._dir / "manifest.json"
+        if not mpath.exists():
+            return False
+        try:
+            manifest = json.loads(mpath.read_text())
+        except Exception:
+            return False
+        if (manifest.get("version") != DATA_STORE_FORMAT_VERSION
+                or int(manifest.get("n_clients", -1)) != self.n_clients
+                or int(manifest.get("shard_rows", -1)) != self.shard_rows):
+            return False
+        saved = [(tuple(l["shape"]), str(l["dtype"]))
+                 for l in manifest.get("leaves", [])]
+        if saved != [(sh, str(dt))
+                     for sh, dt in zip(self._shapes, self._dtypes)]:
+            return False
+        for name, rec in manifest.get("files", {}).items():
+            path = self._dir / name
+            if not path.exists() or path.stat().st_size != int(rec["bytes"]):
+                return False
+            arr = np.load(path, allow_pickle=False, mmap_mode="r")
+            crc = zlib.crc32(memoryview(np.ascontiguousarray(arr)).cast("B"))
+            if (crc & 0xFFFFFFFF) != int(rec["crc32"]):
+                return False
+        return True
+
+    def _write_shards(self, arrays: Sequence[np.ndarray]) -> None:
+        """Stream the population to per-shard files, one bounded slice
+        at a time, with the state-store atomic-write discipline:
+        tmp + fsync + ``os.replace`` per shard, ``manifest.json``
+        published LAST — a kill at any point leaves either no manifest
+        (next open rebuilds) or a fully-verified shard set."""
+        for orphan in self._dir.glob("*.tmp"):
+            orphan.unlink()
+        files: Dict[str, Dict[str, int]] = {}
+        for s, lo, hi in self._shard_ranges():
+            for j, src in enumerate(arrays):
+                block = np.ascontiguousarray(src[lo:hi])
+                name = f"shard-{s:05d}.l{j:02d}.npy"
+                path = self._dir / name
+                tmp = self._dir / (name + ".tmp")
+                with open(tmp, "wb") as f:  # blades-lint: disable=jit-purity — host shard streaming (store init never traces): the atomic per-shard write IS this function's job
+                    np.lib.format.write_array(f, block, allow_pickle=False)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, path)
+                files[name] = {
+                    "bytes": path.stat().st_size,
+                    # Buffer-protocol CRC: no tobytes() copy — the
+                    # streaming contract is bounded memory per shard.
+                    "crc32": zlib.crc32(memoryview(block).cast("B"))
+                    & 0xFFFFFFFF,
+                }
+        from blades_tpu.faults.host import atomic_write_json
+
+        atomic_write_json({
+            "version": DATA_STORE_FORMAT_VERSION,
+            "backend": self.backend,
+            "n_clients": self.n_clients,
+            "shard_rows": self.shard_rows,
+            "num_shards": -(-self.n_clients // self.shard_rows),
+            "leaves": [{"shape": list(sh), "dtype": str(dt)}
+                       for sh, dt in zip(self._shapes, self._dtypes)],
+            "files": files,
+        }, self._dir / "manifest.json")
+
+    def _by_shard(self, ids: np.ndarray):
+        """Group ids by shard in ANY caller order (the async engine
+        gathers event clients in FIFO arrival order): yields
+        ``(shard, caller positions, local row indices)`` where the
+        positions index the caller's ``ids`` array."""
+        order = np.argsort(ids, kind="stable")
+        sorted_ids = ids[order]
+        shard = sorted_ids // self.shard_rows
+        first, last = int(shard[0]), int(shard[-1])
+        bounds = np.searchsorted(shard, np.arange(first, last + 2))
+        for s in range(first, last + 1):
+            lo, hi = int(bounds[s - first]), int(bounds[s - first + 1])
+            if lo < hi:
+                yield s, order[lo:hi], \
+                    sorted_ids[lo:hi] - s * self.shard_rows
+
+    def take(self, ids: np.ndarray) -> Tuple[np.ndarray, ...]:
+        ids = ids.astype(np.int64, copy=False)
+        out = [np.empty((len(ids),) + sh, dt)
+               for sh, dt in zip(self._shapes, self._dtypes)]
+        if len(ids):
+            for s, pos, local in self._by_shard(ids):
+                for j in range(self.num_leaves):
+                    out[j][pos] = self._maps[(s, j)][local]
+        return tuple(out)
+
+    def close(self) -> None:
+        self._maps = {}  # drops the memmap refs (CPython closes them)
+        if self._owns_dir:
+            shutil.rmtree(self._dir, ignore_errors=True)
+
+
+class DataStats:
+    """Host-side staging telemetry the driver stamps into round rows
+    (``data_stage_ms`` / ``data_bytes_staged``)."""
+
+    def __init__(self):
+        self.last_stage_ms = 0.0
+        self.last_bytes_staged = 0
+
+    def observe(self, stage_seconds: float, bytes_staged: int) -> None:
+        self.last_stage_ms = stage_seconds * 1e3
+        self.last_bytes_staged = int(bytes_staged)
+
+
+def make_data_store(backend: str, arrays: Sequence[np.ndarray], *,
+                    directory: Optional[str] = None,
+                    shard_rows: int = DEFAULT_DATA_SHARD_ROWS) -> DataStore:
+    """Build a :class:`DataStore` by backend name over the dataset's
+    ``(x, y, lengths)`` partition leaves.  ``directory`` applies to
+    ``memmap`` only (``None`` = a private temp dir removed on
+    :meth:`~DataStore.close`; an existing verified shard set under a
+    named directory is reused, the resume path)."""
+    if backend == "resident":
+        return ResidentDataStore(arrays)
+    if backend == "memmap":
+        return MemmapDataStore(arrays, directory=directory,
+                               shard_rows=shard_rows)
+    raise ValueError(
+        f"data_store must be one of {DATA_STORE_BACKENDS}, got {backend!r}")
+
+
+def validate_datastore_dir(directory) -> Tuple[int, List[str]]:
+    """The strict forensic walk over one shard directory
+    (``tools/validate_metrics.py --datastore``): verifies the manifest
+    and every recorded shard file (existence, size, shape/dtype,
+    CRC32), and names torn, corrupt, orphaned (``*.tmp`` or
+    unmanifested ``*.npy``) files.  Returns
+    ``(files checked, error strings)`` — empty errors means the
+    directory restores faithfully under any backend."""
+    directory = Path(directory)
+    errors: List[str] = []
+    checked = 0
+    mpath = directory / "manifest.json"
+    if not mpath.exists():
+        return 0, [f"{directory}: no manifest.json (torn shard-set "
+                   "write — the store will rebuild from source)"]
+    try:
+        manifest = json.loads(mpath.read_text())
+    except Exception as exc:
+        return 0, [f"{mpath}: unreadable manifest: {exc}"]
+    if manifest.get("version") != DATA_STORE_FORMAT_VERSION:
+        errors.append(
+            f"{mpath}: format version {manifest.get('version')!r}; this "
+            f"build reads {DATA_STORE_FORMAT_VERSION}")
+        return 0, errors
+    leaves = manifest.get("leaves", [])
+    files = manifest.get("files", {})
+    n_clients = int(manifest.get("n_clients", 0))
+    shard_rows = int(manifest.get("shard_rows", 1))
+    for name, rec in sorted(files.items()):
+        checked += 1
+        path = directory / name
+        if not path.exists():
+            errors.append(f"{name}: missing shard file")
+            continue
+        if path.stat().st_size != int(rec["bytes"]):
+            errors.append(
+                f"{name}: torn shard — {path.stat().st_size} bytes on "
+                f"disk, manifest recorded {rec['bytes']}")
+            continue
+        try:
+            arr = np.load(path, allow_pickle=False, mmap_mode="r")
+        except Exception as exc:
+            errors.append(f"{name}: unreadable shard: {exc}")
+            continue
+        s, j = int(name[6:11]), int(name[13:15])
+        lo = s * shard_rows
+        expect = ((min(lo + shard_rows, n_clients) - lo,)
+                  + tuple(leaves[j]["shape"]))
+        if arr.shape != expect or arr.dtype != np.dtype(leaves[j]["dtype"]):
+            errors.append(
+                f"{name}: shape {arr.shape}/{arr.dtype}, manifest "
+                f"expects {expect}/{leaves[j]['dtype']}")
+            continue
+        crc = zlib.crc32(memoryview(np.ascontiguousarray(arr)).cast("B"))
+        if (crc & 0xFFFFFFFF) != int(rec["crc32"]):
+            errors.append(f"{name}: fails its CRC32 check (corrupt shard)")
+    for orphan in sorted(directory.glob("*.tmp")):
+        errors.append(f"{orphan.name}: orphaned atomic-write temp file "
+                      "(interrupted shard write)")
+    for stray in sorted(directory.glob("shard-*.npy")):
+        if stray.name not in files:
+            errors.append(f"{stray.name}: orphaned shard not in manifest")
+    return checked, errors

@@ -113,6 +113,19 @@ ROUND_RECORD_FIELDS: Dict[str, Tuple[tuple, bool]] = {
     "state_stage_ms": (_NUM, False),
     "state_bytes_staged": ((int,), False),
     "state_peak_hbm_bytes": ((int,), False),
+    # Out-of-core TRAINING DATA (blades_tpu/data/store.py): the data-
+    # plane twin of the state block above, stamped host-side whenever a
+    # DataStore serves the cohort gathers.  data_store names the backend
+    # holding the partition ("resident"|"memmap"), data_stage_ms the
+    # wall time the last cohort gather spent assembling rows (the same
+    # sanctioned-clock caveat as state_stage_ms), data_bytes_staged the
+    # bytes that gather moved, and eval_chunks how many device-sized
+    # chunks the streaming evaluator dispatched (stamped on eval rounds
+    # under data_store="memmap"; the monolithic evaluator never sets it).
+    "data_store": ((str,), False),
+    "data_stage_ms": (_NUM, False),
+    "data_bytes_staged": ((int,), False),
+    "eval_chunks": ((int,), False),
     # comm subsystem (blades_tpu/comm): per-round uplink byte accounting
     # for compressed-update codecs.  comm_bytes_up is the client->server
     # wire payload (reconciled against parallel/comm_model.uplink_bytes),

@@ -71,9 +71,11 @@ class StatePrefetcher:
                  cohort_fn: Callable[[jax.Array], np.ndarray], *,
                  async_staging: bool = False):
         self._store = store
-        # Host-resident inputs by contract (the driver hands numpy):
-        # stored as-is, no conversion that could mask a device leak.
-        self._data = tuple(data)
+        # Host-resident inputs by contract (the driver hands numpy),
+        # stored as-is — OR a blades_tpu.data.stream.DataPrefetcher
+        # when the data plane is itself out-of-core, in which case the
+        # cohort's data shards are gathered on THIS worker too.
+        self._data = data if hasattr(data, "gather") else tuple(data)
         self._malicious = malicious
         self._cohort = cohort_fn
         self._pool = (ThreadPoolExecutor(max_workers=1,
@@ -105,9 +107,15 @@ class StatePrefetcher:
         prev_pos = (np.searchsorted(prev_ids, ids[old_pos])
                     if prev_ids is not None else np.zeros(0, np.int64))
         new_rows = self._store.gather(ids[new_pos])
-        x, y, ln = self._data
-        data = (jnp.asarray(x[ids]), jnp.asarray(y[ids]),
-                jnp.asarray(ln[ids]))
+        if hasattr(self._data, "gather"):
+            # Out-of-core data plane: the cohort's shards ride this
+            # same FIFO worker.  No write-read hazard applies — data
+            # rows are immutable — so the FULL cohort is gathered.
+            data = self._data.gather(ids)
+        else:
+            x, y, ln = self._data
+            data = (jnp.asarray(x[ids]), jnp.asarray(y[ids]),
+                    jnp.asarray(ln[ids]))
         mal = jnp.asarray(self._malicious[ids])
         staged_bytes = (len(new_pos) * self._store.row_bytes
                         + sum(d.size * np.dtype(d.dtype).itemsize

@@ -199,6 +199,19 @@ def main(argv=None) -> int:
                        "EF-residual rows live; 'host'/'disk' require "
                        "--window (see README \"Out-of-core client "
                        "state\")")
+    p_run.add_argument("--data-store", default=None,
+                       choices=("resident", "memmap"),
+                       help="out-of-core training-data backend "
+                       "(blades_tpu/data/store.py): 'memmap' spills the "
+                       "per-client partition to CRC'd disk shards and "
+                       "gathers only each cohort's rows; needs --window "
+                       "or async × out-of-core --state-store (see README "
+                       "\"Out-of-core training data\")")
+    p_run.add_argument("--data-dir", default=None, metavar="DIR",
+                       help="live shard directory for --data-store "
+                       "memmap (default: a private temp dir); a matching "
+                       "manifest is reused on resume, a mismatch "
+                       "rebuilds from source")
     p_run.add_argument("--topology", default=None,
                        choices=("ring", "torus", "kregular", "erdos",
                                 "complete"),
@@ -317,6 +330,10 @@ def main(argv=None) -> int:
             run_config["state_store"] = args.state_store
         if args.window is not None:
             run_config["state_window"] = args.window
+        if args.data_store is not None:
+            run_config["data_store"] = args.data_store
+        if args.data_dir is not None:
+            run_config["data_dir"] = args.data_dir
         experiments = {
             f"{args.algo.lower()}_run": {
                 "run": args.algo,

@@ -1408,6 +1408,12 @@ def run_experiments(
                 # backend + window + the staging peak, mirrored from the
                 # row stamps like the comm/arrivals blocks.
                 summary["state_store"] = state_block
+            data_block = getattr(algo, "data_summary", None)
+            if data_block:
+                # Out-of-core training data (blades_tpu/data/store):
+                # backend + partition geometry + the last gather's
+                # staging stats + streaming-eval chunk count.
+                summary["data_store"] = data_block
             ledger_block = getattr(algo, "ledger_summary", None)
             if ledger_block:
                 # Client-lifetime ledger (blades_tpu/obs/ledger): fleet

@@ -1,0 +1,27 @@
+"""Seeded data-store-discipline violations (blades-lint fixture, never
+imported): blocking device syncs inside the out-of-core data plane —
+the cohort gather and the streaming evaluator — OUTSIDE the sanctioned
+per-chunk scalar fetch.  Scanned only when the test instantiates
+HostSyncPass with this path in its module list (the real pass scans
+blades_tpu/data/store.py + stream.py via DEVICE_SIDE).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def leaky_gather(store, ids):
+    rows = store.take(ids)
+    checksum = float(jnp.abs(rows[0]).sum())  # BAD: blocks the gather on the device
+    host_rows = np.asarray(rows[0])  # BAD: numpy conversion mid-gather
+    return rows, checksum, host_rows
+
+
+def leaky_chunk_eval(chunk_fn, params, cx, cy, lengths):
+    sums = chunk_fn(params, cx, cy, lengths)
+    # BAD: fetching the whole per-client tensor defeats the chunked
+    # evaluator — only the four reduced scalars are sanctioned.
+    per_client = jax.device_get(sums)
+    count = sums["count"].item()  # BAD: .item()
+    sums["ce_sum"].block_until_ready()  # BAD: queue drain in the hot path
+    return per_client, count

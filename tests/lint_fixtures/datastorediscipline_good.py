@@ -1,0 +1,19 @@
+"""Clean data-plane code (blades-lint fixture, never imported): the
+sanctioned per-chunk scalar fetch carries a justification pragma; the
+shard assembly itself is host numpy over memmaps (no device in sight)
+and the staged cohort moves device-ward exactly once."""
+import jax.numpy as jnp
+import numpy as np
+
+
+def gather_cohort(maps, order, rows_out):
+    for shard, pos, rel in order:
+        rows_out[pos] = maps[shard][rel]  # memmap read: host IO, not a sync
+    return tuple(jnp.asarray(a) for a in rows_out)  # one host->device move
+
+
+def accumulate_chunk(chunk_fn, params, cx, cy, lengths, totals):
+    sums = chunk_fn(params, cx, cy, lengths)
+    for k in ("ce_sum", "top1_sum", "top3_sum", "count"):
+        totals[k] += float(sums[k])  # blades-lint: disable=host-sync — sanctioned eval sync: four scalars per chunk, fetched so the full per-client stack never materializes on device
+    return totals

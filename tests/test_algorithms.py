@@ -249,6 +249,10 @@ def test_rounds_per_dispatch_chunked_driver():
     assert r["training_iteration"] == 10
 
 
+# Driver-level duplicate of tests/test_streamed.py's streamed-vs-dense
+# fixture (which keeps a tier-1 arm); ~6 s of repeat compile rides the
+# slow lane (PR 20 budget rebalance).
+@pytest.mark.slow
 def test_streamed_execution_matches_dense():
     """execution='streamed' with f32 storage reproduces the dense path
     bit-for-bit through the full Fedavg API (parallel/streamed.py's

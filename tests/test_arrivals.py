@@ -332,6 +332,11 @@ def test_async_kill_and_resume_bit_identical(tmp_path):
         assert np.array_equal(np.asarray(pa), np.asarray(pc))
 
 
+# Second kill-and-resume in this file (~10 s): the core async resume
+# contract stays tier-1 via test_async_kill_and_resume_bit_identical;
+# this arm pins the rate_schedule rewind specifically (PR 20 budget
+# rebalance).
+@pytest.mark.slow
 def test_rate_schedule_resume_reenters_at_restored_tick(tmp_path):
     """ISSUE 17 regression: a kill-and-resume mid-``rate_schedule`` must
     re-enter the schedule at the RESTORED tick, not tick 0 — campaign
@@ -373,6 +378,10 @@ def test_rate_schedule_resume_reenters_at_restored_tick(tmp_path):
     assert float(proc.rate_at(rows_c[-1]["tick"])) == pytest.approx(0.1)
 
 
+# Chaos x async composition (~6 s compile): dropout and corruption are
+# each covered tier-1 on the sync path; the composed arm rides the slow
+# lane (PR 20 budget rebalance).
+@pytest.mark.slow
 def test_async_chaos_dropout_and_corruption_compose():
     """Chaos composes with arrivals: dropout deterministically thins the
     ingest stream (counted, replayable), NaN corruption rides an event
@@ -655,6 +664,10 @@ def test_async_cutoff_all_stale_batch_warns():
     assert row["staleness_mean"] >= 1.0
 
 
+# Flight-recorder replay through the async cycle (~5 s): the replay
+# contract is tier-1 on the sync path (tools/replay_round.py tests);
+# the async arm rides the slow lane (PR 20 budget rebalance).
+@pytest.mark.slow
 def test_flightrec_replay_async_round(tmp_path):
     """tools/replay_round understands tick-indexed async rows: replay to
     a recorded virtual tick reproduces the digest bit-identically."""

@@ -38,6 +38,7 @@ from blades_tpu.control.policy import (
     decide_probe,
     decide_quarantine,
     decide_replan,
+    decide_window,
 )
 
 N = 8  # tiny-federation size for the driver tests
@@ -102,6 +103,8 @@ def test_policy_from_config_fail_fast_and_rules_merge():
     {"buffer_factor": 1},
     {"cutoff_factor": 1},
     {"min_agg_every": 0},
+    {"window_factor": 1},
+    {"min_window": 0},
 ])
 def test_policy_knob_validation(bad):
     with pytest.raises(ValueError):
@@ -119,6 +122,25 @@ def test_decide_agg_every_bounded_one_directional():
     # Sync driver has no agg cadence.
     assert decide_agg_every(p, seq=0, round_idx=5, tick=9,
                             rule="staleness_runaway", pre={"old": None}) is None
+
+
+def test_decide_window_bounded_one_directional():
+    """ISSUE 20: the out-of-core window family mirrors agg_every —
+    shrink-only toward min_window, silent at the floor, None on
+    drivers without a window to move."""
+    p = ControlPolicy(min_window=4, window_factor=2)
+    act = decide_window(p, seq=0, round_idx=5, tick=9,
+                        rule="staleness_runaway", pre={"old": 16})
+    assert (act.actuator, act.old, act.new) == ("window", 16, 8)
+    # Factor overshooting the floor clamps TO the floor, once.
+    act = decide_window(p, seq=0, round_idx=5, tick=9,
+                        rule="staleness_runaway", pre={"old": 6})
+    assert act.new == 4
+    # At the floor: bounded means silent, not clamped re-fires.
+    assert decide_window(p, seq=0, round_idx=5, tick=9,
+                         rule="staleness_runaway", pre={"old": 4}) is None
+    assert decide_window(p, seq=0, round_idx=5, tick=9,
+                         rule="staleness_runaway", pre={"old": None}) is None
 
 
 def test_decide_buffer_grows_then_relaxes_cutoff():
@@ -210,10 +232,12 @@ def test_rederive_action_every_actuator():
                       rule="round_time_regression", pre={"allowed": True}),
         decide_probe(p, seq=4, round_idx=5, tick=6,
                      pre={"due": [3], "active": 2}),
+        decide_window(p, seq=7, round_idx=8, tick=9,
+                      rule="staleness_runaway", pre={"old": 16}),
     ] + decide_probation(p, round_idx=6, tick=7, seq0=5,
                          pre={"probation": [3, 5], "participants": [3, 5],
                               "flagged": [3]})
-    assert len(cases) == 7  # probation emitted the (requarantine, readmit) pair
+    assert len(cases) == 8  # probation emitted the (requarantine, readmit) pair
     for act in cases:
         d = act.as_dict()
         re = rederive_action(p, json.loads(json.dumps(d)),
@@ -254,6 +278,32 @@ def test_controller_cooldown_prevents_oscillation():
     assert len(c.journal) == 3
     # Unmapped rules and rules mapped "off" produce no action at all.
     assert c.step(round_idx=13, tick=13, events=[{"rule": "nan_loss"}]) == []
+
+
+def test_controller_window_family_rides_cooldown():
+    """ISSUE 20: a rule mapped to the window family drives bounded
+    shrink-only moves on the controller's ``window`` view, with the
+    same per-family cooldown hysteresis as agg_every; an unseeded
+    window (non-ooc driver) stays silent."""
+    policy = ControlPolicy(
+        rule_table=(("staleness_runaway", "window"),),
+        cooldown_rounds=4, min_window=4)
+    c = Controller(policy, num_clients=8, window=16)
+    ev = {"rule": "staleness_runaway"}
+    fired = []
+    for r in range(9):
+        acts = c.step(round_idx=r, tick=r, events=[ev])
+        fired += [(a.round, a.actuator, a.old, a.new) for a in acts]
+    assert fired == [(0, "window", 16, 8), (4, "window", 8, 4)]
+    assert c.values["window"] == 4
+    assert c.step(round_idx=9, tick=9, events=[ev]) == []  # at the floor
+    # The window view rides state()/restore() with the other values.
+    resumed = Controller(policy, num_clients=8, window=16)
+    resumed.restore(json.loads(json.dumps(c.state())))
+    assert resumed.values["window"] == 4
+    # Unseeded window (sync / resident drivers): nothing to move.
+    idle = Controller(policy, num_clients=8)
+    assert idle.step(round_idx=0, tick=0, events=[ev]) == []
 
 
 def test_controller_quarantine_probe_readmit_cycle():

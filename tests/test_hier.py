@@ -292,6 +292,11 @@ def _tiny_driver(n, *, seed=0, faults=None, num_malicious=0):
     return cfg.build()
 
 
+# 10k-registered mesh round: shard_map compiles are the most expensive
+# tier-1 class (~8 s); the hier path keeps its bit-identity grid and
+# kill-and-resume tier-1, the scale acceptance rides the slow lane
+# (PR 20 budget rebalance).
+@pytest.mark.slow
 def test_10k_registered_clients_hier_round_completes():
     """The ISSUE 18 acceptance run, scaled for the CPU tier-1 box:
     10 240 registered clients on the 8-virtual-device mesh complete a

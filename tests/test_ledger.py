@@ -620,7 +620,7 @@ def _rows(tdir, keep_eval_rounds=(4, 8)):
     for ln in (Path(tdir) / "result.json").read_text().strip().splitlines():
         r = json.loads(ln)
         for k in ("timers", "compile_cache_hits", "compile_cache_misses",
-                  "state_stage_ms", "state_bytes_staged"):
+                  "state_stage_ms", "state_bytes_staged", "data_stage_ms"):
             r.pop(k, None)  # wall-clock / cache / staging-timing noise
         if r["training_iteration"] not in keep_eval_rounds:
             for k in ("test_loss", "test_acc", "test_acc_top3"):

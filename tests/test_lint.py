@@ -136,6 +136,35 @@ def test_staging_discipline_fixtures():
     assert run_fixture([hs_good], "stagingdiscipline_good.py") == []
 
 
+def test_datastore_discipline_fixtures():
+    """ISSUE 20: the host-sync pass covers the out-of-core data plane
+    (blades_tpu/data/store.py + stream.py ride DEVICE_SIDE) — cohort
+    gathers are host IO by construction and the streaming evaluator's
+    only sanctioned sync is the pragma'd four-scalar per-chunk fetch;
+    any other blocking fetch is a finding."""
+    from tools.lint.passes.host_sync import DEVICE_SIDE
+    from tools.lint.passes.purity import TRACED_MODULES
+
+    assert "blades_tpu/data/store.py" in DEVICE_SIDE
+    assert "blades_tpu/data/stream.py" in DEVICE_SIDE
+    # ...and both in jit-purity's whole-module set: the chunked eval
+    # program traces, and the shard writer's file IO is pragma'd.
+    assert "blades_tpu/data/store.py" in TRACED_MODULES
+    assert "blades_tpu/data/stream.py" in TRACED_MODULES
+    hs = HostSyncPass(modules=[f"{FIX}/datastorediscipline_bad.py"])
+    bad = errors_of(run_fixture([hs], "datastorediscipline_bad.py"),
+                    "host-sync")
+    msgs = "\n".join(f.message for f in bad)
+    assert "float() on an array expression" in msgs
+    assert "np.asarray()" in msgs
+    assert "jax.device_get()" in msgs
+    assert ".item()" in msgs
+    assert ".block_until_ready()" in msgs
+    assert len(bad) == 5
+    hs_good = HostSyncPass(modules=[f"{FIX}/datastorediscipline_good.py"])
+    assert run_fixture([hs_good], "datastorediscipline_good.py") == []
+
+
 def test_ledger_discipline_fixtures():
     """ISSUE 16: the host-sync pass covers the client ledger's
     per-round update path (blades_tpu/obs/ledger.py rides DEVICE_SIDE)

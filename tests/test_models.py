@@ -145,6 +145,10 @@ def test_catalog_resolves_named_cct_variants():
     assert m.apply(params, x).shape == (1, 7)
 
 
+# Offline weight-import utility, not a round-path contract; the CCT
+# forward-shape test above stays tier-1 (~6 s saved, PR 20 budget
+# rebalance).
+@pytest.mark.slow
 def test_cct_pretrained_weight_import(tmp_path):
     """The reference's pretrained-checkpoint hooks (pe_check /
     resize_pos_embed / fc_check, cctnets/utils/helpers.py) in flax form:

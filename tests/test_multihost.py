@@ -115,6 +115,10 @@ def _multiprocess_capability() -> str:
     return ""
 
 
+# Spawns two real processes, each paying its own XLA CPU compile (~5 s
+# plus interpreter start); the distributed round logic stays tier-1 on
+# the in-process simulation tests (PR 20 budget rebalance).
+@pytest.mark.slow
 def test_two_process_distributed_round():
     reason = _multiprocess_capability()
     if reason:

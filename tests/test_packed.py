@@ -159,6 +159,11 @@ def test_packed_matches_unpacked_per_aggregator(agg_name):
     _assert_close_trees(s_u, s_p, msg=agg_name)
 
 
+# CNN compile x packing x forensics (~6 s); packed parity and forensics
+# detection are each pinned tier-1 separately
+# (test_packed_matches_unpacked_per_aggregator[Mean], tests/test_ledger)
+# (PR 20 budget rebalance).
+@pytest.mark.slow
 def test_packed_cnn_ipm_forensics_detection_parity():
     """Acceptance: grouped-conv packed FashionCNN under IPM forging with
     forensics on — the aggregator's per-lane decisions (benign mask,
@@ -179,6 +184,10 @@ def test_packed_cnn_ipm_forensics_detection_parity():
     _assert_close_trees(s_u, s_p)
 
 
+# Packing x codec transitivity (~6 s); both halves are tier-1 on their
+# own (packed parity above, identity-codec bit-identity in
+# tests/test_comm.py) (PR 20 budget rebalance).
+@pytest.mark.slow
 def test_packed_under_identity_codec():
     """Acceptance: packing composes with the comm layer — the identity
     codec is bit-transparent on the packed path (identical RoundState
@@ -314,6 +323,10 @@ def test_auto_fallback_on_training_hook_adversary():
         resolve_client_packing(fr, 2, num_clients=6)
 
 
+# End-to-end auto-resolution run (~5 s); the resolver's decision logic
+# is covered tier-1 by the resolve_client_packing unit tests above
+# (PR 20 budget rebalance).
+@pytest.mark.slow
 def test_auto_fallback_when_auto_execution_resolves_streamed(monkeypatch):
     """'auto' packing keeps its loud-fallback contract when
     execution='auto' itself resolves to the streamed round (HBM-driven,

@@ -295,6 +295,10 @@ def test_cohort_equivalence_across_backends(aggregator):
             a.stop()
 
 
+# Codec-EF x store composition (~8 s compile); the store's headline
+# cross-backend equivalence stays tier-1 via
+# test_cohort_equivalence_across_backends[Mean] (PR 20 budget rebalance).
+@pytest.mark.slow
 def test_topk_ef_residual_through_store():
     """topk+EF codec under the window: the per-client error-feedback
     residual lives in the store (windowed like the opt state) and the
@@ -350,7 +354,7 @@ def _result_rows(tdir, keep_eval_rounds=(4, 8)):
     for ln in (Path(tdir) / "result.json").read_text().strip().splitlines():
         r = json.loads(ln)
         for k in ("timers", "compile_cache_hits", "compile_cache_misses",
-                  "state_stage_ms", "state_bytes_staged"):
+                  "state_stage_ms", "state_bytes_staged", "data_stage_ms"):
             r.pop(k, None)  # wall-clock / cache / staging-timing noise
         if r["training_iteration"] not in keep_eval_rounds:
             # Repeat-last-eval rows: _last_eval is not checkpointed (a

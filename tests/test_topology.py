@@ -260,6 +260,10 @@ def test_complete_mean_gossip_bit_identical_to_dense():
 # ---------------------------------------------------------------------------
 
 
+# Byte-accounting reconciliation over a full gossip compile (~6 s); the
+# gossip round path itself stays tier-1 via the complete-graph + Mean
+# centralized-equivalence test (PR 20 budget rebalance).
+@pytest.mark.slow
 def test_gossip_ici_reconciles_with_comm_model_both_ways():
     """Every collective the traced gossip program counted must appear in
     the analytic inventory with the same (kind, payload, ring), and vice

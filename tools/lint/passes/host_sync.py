@@ -49,6 +49,14 @@ DEVICE_SIDE = (
     # pragmas; everything else is a finding.
     "blades_tpu/state/store.py",
     "blades_tpu/state/prefetch.py",
+    # Out-of-core training data (ISSUE 20): the data store + streaming
+    # plumbing are the data-plane staging hot path — cohort gathers ride
+    # the state prefetcher's FIFO worker and the chunked evaluator's
+    # per-chunk scalar fetch is the ONE sanctioned eval sync (four
+    # scalars per chunk, pragma'd at the site).  Any other blocking
+    # fetch here stalls the round pipeline exactly like state staging.
+    "blades_tpu/data/store.py",
+    "blades_tpu/data/stream.py",
     # Client-lifetime ledger (ISSUE 16): observe() runs once per round
     # on the driver thread between dispatches — an unsanctioned device
     # fetch there re-introduces exactly the per-round stall the

@@ -202,7 +202,8 @@ def main(argv=None) -> int:
         prog="tools.validate_metrics",
         description="schema-check observability artifacts: metrics.jsonl "
                     "(default), flight-recorder dumps (--flightrec), "
-                    "span traces (--trace)",
+                    "span traces (--trace), ledger checkpoints "
+                    "(--ledger), data-store shard dirs (--datastore)",
     )
     mode = p.add_mutually_exclusive_group()
     mode.add_argument("--flightrec", action="store_true",
@@ -212,6 +213,10 @@ def main(argv=None) -> int:
     mode.add_argument("--ledger", action="store_true",
                       help="validate client-ledger checkpoint "
                            "director(ies)")
+    mode.add_argument("--datastore", action="store_true",
+                      help="validate out-of-core data-store shard "
+                           "director(ies): manifest walk + per-shard "
+                           "size/dtype/CRC checks")
     p.add_argument("paths", nargs="+")
     args = p.parse_args(argv)
 
@@ -235,6 +240,11 @@ def main(argv=None) -> int:
             from blades_tpu.obs.ledger import validate_ledger_checkpoint
 
             num, errors = validate_ledger_checkpoint(path)
+            rc |= _report(path, num, "shard file(s)", errors)
+        elif args.datastore:
+            from blades_tpu.data.store import validate_datastore_dir
+
+            num, errors = validate_datastore_dir(path)
             rc |= _report(path, num, "shard file(s)", errors)
         else:
             from blades_tpu.obs.schema import validate_jsonl
